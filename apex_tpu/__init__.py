@@ -1,0 +1,48 @@
+"""apex_tpu — a TPU-native mixed-precision / fused-kernel / data-parallel training
+framework built on JAX, XLA, and Pallas.
+
+This package provides the capabilities of NVIDIA Apex (reference:
+``/root/reference`` — ``apex/__init__.py:1-13``) redesigned for TPU:
+
+- :mod:`apex_tpu.amp` — automatic mixed precision with O0–O3-style policies,
+  a jit-safe dynamic loss scaler, and fp32 master-weight management
+  (reference ``apex/amp``).
+- :mod:`apex_tpu.optimizers` — ``FusedAdam``, ``FusedLAMB``, ``FP16Optimizer``
+  (reference ``apex/optimizers`` + ``csrc/fused_adam_cuda*``,
+  ``csrc/multi_tensor_lamb_stage_{1,2}.cu``).
+- :mod:`apex_tpu.normalization` — ``FusedLayerNorm`` (reference
+  ``apex/normalization/fused_layer_norm.py`` + ``csrc/layer_norm_cuda*``).
+- :mod:`apex_tpu.parallel` — data-parallel gradient reduction over a
+  ``jax.sharding.Mesh``, ``Reducer``, ``SyncBatchNorm``, ``LARC``
+  (reference ``apex/parallel``).
+- :mod:`apex_tpu.multi_tensor_apply` / :mod:`apex_tpu.ops` — fused
+  multi-tensor scale / axpby / l2norm over packed parameter pytrees
+  (reference ``apex/multi_tensor_apply`` + ``csrc/multi_tensor_*``).
+- :mod:`apex_tpu.fp16_utils` — model/dtype conversion helpers, master-param
+  utilities, and legacy loss scalers (reference ``apex/fp16_utils``).
+
+Unlike the reference, which monkey-patches eager PyTorch, everything here is
+functional and jit-compiled: loss-scale state is a pytree carried through the
+step function, overflow skipping is a ``jnp.where`` (never a host sync), and
+gradient reduction is ``jax.lax.psum`` over mesh axes with XLA doing the
+compute/communication overlap that apex's bucketed NCCL streams did by hand.
+"""
+
+from apex_tpu import amp
+from apex_tpu import fp16_utils
+from apex_tpu import multi_tensor_apply
+from apex_tpu import normalization
+from apex_tpu import optimizers
+from apex_tpu import parallel
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "amp",
+    "fp16_utils",
+    "multi_tensor_apply",
+    "normalization",
+    "optimizers",
+    "parallel",
+    "__version__",
+]

@@ -1,0 +1,50 @@
+"""apex_tpu.amp — automatic mixed precision for JAX/TPU.
+
+Public surface mirroring the reference ``apex/amp/__init__.py:1-4``
+(``initialize``, ``scale_loss``-style flow, ``disable_casts``,
+``half_function``/``float_function``/``promote_function`` + ``register_*``)
+plus the functional state machine pieces that replace eager monkey-patching:
+:class:`Amp`, :class:`AmpState`, :class:`LossScaler`, :func:`make_train_step`.
+"""
+
+from apex_tpu.amp import lists, ops
+from apex_tpu.amp.frontend import (
+    Amp,
+    AmpState,
+    default_keep_fp32_filter,
+    initialize,
+    make_train_step,
+)
+from apex_tpu.amp.ops import (
+    banned_function,
+    cast_context,
+    disable_casts,
+    float_function,
+    half_function,
+    promote_function,
+    register_float_function,
+    register_half_function,
+    register_promote_function,
+)
+from apex_tpu.amp.policy import DYNAMIC, O0, O1, O2, O3, Properties, opt_levels, resolve
+from apex_tpu.amp.scaler import LossScaler, LossScaleState, all_finite
+
+__all__ = [
+    "Amp", "AmpState", "initialize", "make_train_step",
+    "default_keep_fp32_filter",
+    "Properties", "O0", "O1", "O2", "O3", "opt_levels", "resolve", "DYNAMIC",
+    "LossScaler", "LossScaleState", "all_finite",
+    "ops", "lists",
+    "cast_context", "disable_casts",
+    "half_function", "float_function", "promote_function", "banned_function",
+    "register_half_function", "register_float_function",
+    "register_promote_function",
+]
+
+
+def master_params(state: AmpState):
+    """Generator over the fp32 master params (reference ``amp.master_params``,
+    ``apex/amp/_initialize.py`` / ``frontend.py`` export): iterate these for
+    gradient clipping or inspection."""
+    import jax
+    yield from jax.tree.leaves(state.master_params)

@@ -1,0 +1,324 @@
+"""amp frontend: ``initialize`` + the mixed-precision train-step machinery.
+
+TPU-native port of the reference frontend/initialization/optimizer-surgery
+stack (``apex/amp/frontend.py:194-353``, ``_initialize.py:150-268``,
+``_process_optimizer.py``, ``handle.py:15-154``).  The reference mutates the
+user's model and optimizer in place (monkey-patched ``step``/``zero_grad``,
+fp32 master clones swapped into param groups, grad hooks).  Here the same
+observable semantics are a pure state machine:
+
+- fp32 master params are a pytree in :class:`AmpState` (reference
+  ``_process_optimizer.py:29-36`` master clones);
+- the half-precision *compute* params are derived by :meth:`Amp.model_params`
+  each step (reference ``_master_params_to_model_params`` copy-back,
+  ``_process_optimizer.py:242-253`` — under jit, XLA keeps the cast fused
+  into the consumers, so the "copy" costs one pass at most);
+- loss scaling / unscaling / overflow-skip are the
+  :class:`~apex_tpu.amp.scaler.LossScaler` transitions wired into
+  :meth:`Amp.apply_gradients` with ``lax.cond`` skip (reference
+  ``handle.py:110-150`` scale_loss enter/exit + skip_step patching);
+- the whole iteration compiles to one XLA program with **zero** host syncs
+  (the reference needed one ``.item()`` per step, ``scaler.py:192-193``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.amp import ops as amp_ops
+from apex_tpu.amp import policy as policy_lib
+from apex_tpu.amp import scaler as scaler_lib
+from apex_tpu.amp.policy import Properties
+from apex_tpu.amp.scaler import LossScaler, LossScaleState
+
+# Default name fragments identifying normalization params kept in fp32 under
+# keep_batchnorm_fp32 (reference skips _BatchNorm modules during the O2 cast,
+# fp16util.py:44-70). Matches flax's BatchNorm_*/LayerNorm_*/GroupNorm_* and
+# common hand-rolled names.
+_NORM_NAME_FRAGMENTS = ("batchnorm", "layernorm", "groupnorm", "norm", "bn")
+
+
+def default_keep_fp32_filter(path: Tuple[Any, ...]) -> bool:
+    """True for param paths that look like normalization-layer params."""
+    for entry in path:
+        name = str(getattr(entry, "key", getattr(entry, "name", entry))).lower()
+        if any(frag in name for frag in _NORM_NAME_FRAGMENTS):
+            return True
+    return False
+
+
+class AmpState(NamedTuple):
+    """Carried training state for one (model, optimizer) pair.
+
+    ``master_params`` is fp32 when master weights are on; otherwise it holds
+    the params at model dtype (O0/O1/O3 semantics — the optimizer runs
+    directly on them, ``_process_optimizer.py:165-239``).
+    """
+
+    master_params: Any
+    opt_state: Any
+    scaler_states: Tuple[LossScaleState, ...]
+    step: jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class Amp:
+    """Bound mixed-precision configuration (the return of :func:`initialize`)."""
+
+    properties: Properties
+    scaler: LossScaler
+    tx: optax.GradientTransformation
+    apply_fn: Optional[Callable] = None
+    num_losses: int = 1
+    keep_fp32_filter: Callable[[Tuple[Any, ...]], bool] = default_keep_fp32_filter
+
+    # ------------------------------------------------------------------
+    # setup
+    # ------------------------------------------------------------------
+    def init(self, params: Any) -> AmpState:
+        """Build the initial state from user fp32 params (reference
+        ``_initialize.py:176-177`` requires incoming fp32; we cast to be safe,
+        mirroring ``allow_incoming_model_not_fp32`` leniency)."""
+        p = self.properties
+        if p.enabled and self._use_master_weights():
+            master = jax.tree.map(lambda x: x.astype(jnp.float32)
+                                  if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                                  params)
+        else:
+            master = self.model_params_from(params)
+        return AmpState(
+            master_params=master,
+            opt_state=self.tx.init(master),
+            scaler_states=tuple(self.scaler.init_state()
+                                for _ in range(self.num_losses)),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    def _use_master_weights(self) -> bool:
+        p = self.properties
+        if p.master_weights is not None:
+            return bool(p.master_weights)
+        # O1 leaves params fp32: the "masters" are the params themselves.
+        return p.cast_model_dtype is not None and p.cast_model_dtype != jnp.float32
+
+    def _cast_leaf_dtype(self, path) -> Any:
+        p = self.properties
+        if not p.enabled or p.cast_model_dtype is None:
+            return None  # leave as-is
+        if p.keep_batchnorm_fp32 and self.keep_fp32_filter(path):
+            return jnp.float32
+        return p.cast_model_dtype
+
+    def model_params_from(self, params: Any) -> Any:
+        """Cast a param pytree to compute precision per the policy
+        (reference ``_initialize.py:183-189`` model cast, batchnorm-safe via
+        ``convert_network``)."""
+        def cast(path, x):
+            dt = self._cast_leaf_dtype(path)
+            if dt is None or not jnp.issubdtype(x.dtype, jnp.floating):
+                return x
+            return x.astype(dt)
+        return jax.tree_util.tree_map_with_path(cast, params)
+
+    def model_params(self, state: AmpState) -> Any:
+        """Compute-precision view of the masters — the per-step equivalent of
+        the reference's master→model fused copy
+        (``_process_optimizer.py:242-253``)."""
+        return self.model_params_from(state.master_params)
+
+    # ------------------------------------------------------------------
+    # model application (reference _initialize.py:197-208 forward patch)
+    # ------------------------------------------------------------------
+    def apply(self, params: Any, *args, **kwargs):
+        """Run the bound model with policy-correct input/output casting and,
+        under O1, the cast-ops context active."""
+        if self.apply_fn is None:
+            raise ValueError("This Amp was initialized without a model apply_fn.")
+        return self.run(self.apply_fn, params, *args, **kwargs)
+
+    def run(self, fn: Callable, params: Any, *args, **kwargs):
+        """Like :meth:`apply` for an arbitrary function taking ``params``."""
+        p = self.properties
+        if not p.enabled:
+            return fn(params, *args, **kwargs)
+        if p.cast_model_dtype is not None and p.cast_model_dtype != jnp.float32:
+            args, kwargs = amp_ops._cast_tree((args, kwargs), p.cast_model_dtype)
+        if p.cast_ops:
+            with amp_ops.cast_context(p):
+                out = fn(params, *args, **kwargs)
+        else:
+            out = fn(params, *args, **kwargs)
+        out_dtype = (p.cast_model_outputs if p.cast_model_outputs is not None
+                     else jnp.float32)
+        if p.cast_model_dtype is not None and p.cast_model_dtype != jnp.float32:
+            out = amp_ops._cast_tree(out, out_dtype)
+        return out
+
+    # ------------------------------------------------------------------
+    # loss scaling (reference handle.py scale_loss)
+    # ------------------------------------------------------------------
+    def scale_loss(self, loss: jax.Array, state: AmpState,
+                   loss_id: int = 0) -> jax.Array:
+        """``loss * loss_scale`` for the selected scaler
+        (``handle.py:96,116``)."""
+        if not self.properties.enabled:
+            return loss
+        return self.scaler.scale_loss(loss, state.scaler_states[loss_id])
+
+    # ------------------------------------------------------------------
+    # gradient application (reference handle.py exit + patched step)
+    # ------------------------------------------------------------------
+    def apply_gradients(
+        self,
+        state: AmpState,
+        grads: Any,
+        loss_id: int = 0,
+        stashed_grads: Optional[Any] = None,
+        reduce_fn: Optional[Callable[[Any], Any]] = None,
+    ) -> Tuple[AmpState, dict]:
+        """Unscale → finite-check → scaler update → conditionally step.
+
+        ``grads`` are w.r.t. the *compute* params (still loss-scaled, at
+        compute dtype — exactly what materializes from the backward pass in
+        the reference).  ``reduce_fn`` (e.g. a data-parallel psum from
+        :mod:`apex_tpu.parallel`) runs on the scaled grads, matching the
+        reference DDP which allreduces scaled fp16 grads before unscaling.
+        ``stashed_grads`` selects the gradient-accumulation path
+        (``unscale_with_stashed``, ``_process_optimizer.py:125-129``).
+
+        Returns ``(new_state, info)`` with ``info = {"overflow", "loss_scale"}``
+        — both device arrays; nothing here syncs to the host.
+        """
+        if not self.properties.enabled:
+            updates, opt_state = self.tx.update(grads, state.opt_state,
+                                                state.master_params)
+            master = optax.apply_updates(state.master_params, updates)
+            return (AmpState(master, opt_state, state.scaler_states,
+                             state.step + 1),
+                    {"overflow": jnp.asarray(False),
+                     "loss_scale": jnp.asarray(1.0, jnp.float32)})
+
+        if reduce_fn is not None:
+            grads = reduce_fn(grads)
+
+        sstate = state.scaler_states[loss_id]
+        if stashed_grads is not None:
+            grads32, finite = self.scaler.unscale_with_stashed(
+                grads, stashed_grads, sstate)
+        else:
+            grads32, finite = self.scaler.unscale(grads, sstate)
+        new_sstate, overflow = self.scaler.update(sstate, finite)
+
+        def do_step(operand):
+            master, opt_state = operand
+            updates, new_opt_state = self.tx.update(grads32, opt_state, master)
+            new_master = optax.apply_updates(master, updates)
+            return new_master, new_opt_state
+
+        def skip_step(operand):
+            # Reference: patched skip_step clears grads and does nothing
+            # (handle.py:131-150).
+            return operand
+
+        master, opt_state = jax.lax.cond(
+            overflow, skip_step, do_step,
+            (state.master_params, state.opt_state))
+
+        scaler_states = tuple(
+            new_sstate if i == loss_id else s
+            for i, s in enumerate(state.scaler_states))
+        new_state = AmpState(master, opt_state, scaler_states, state.step + 1)
+        return new_state, {"overflow": overflow,
+                           "loss_scale": new_sstate.loss_scale}
+
+
+def initialize(
+    apply_fn: Optional[Callable] = None,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    opt_level: str = "O1",
+    enabled: bool = True,
+    half_dtype=jnp.bfloat16,
+    cast_model_dtype=None,
+    cast_ops: Optional[bool] = None,
+    keep_batchnorm_fp32: Union[None, bool, str] = None,
+    master_weights: Optional[bool] = None,
+    loss_scale: Union[None, float, str] = None,
+    cast_model_outputs=None,
+    num_losses: int = 1,
+    min_loss_scale: Optional[float] = None,
+    max_loss_scale: float = 2.0 ** 24,
+    keep_fp32_filter: Callable = default_keep_fp32_filter,
+    verbosity: int = 1,
+) -> Amp:
+    """Resolve an opt level + overrides into a bound :class:`Amp`
+    (reference ``amp.initialize``, ``frontend.py:194-353``).
+
+    Unlike the reference this does not mutate a model/optimizer — it returns
+    the pure state machine; pair it with :func:`make_train_step` or drive
+    ``init`` / ``model_params`` / ``scale_loss`` / ``apply_gradients``
+    yourself (the explicit analog of the ``with amp.scale_loss(...)`` loop).
+    """
+    props = policy_lib.resolve(
+        opt_level=opt_level, half_dtype=half_dtype, enabled=enabled,
+        cast_model_dtype=cast_model_dtype, cast_ops=cast_ops,
+        keep_batchnorm_fp32=keep_batchnorm_fp32, master_weights=master_weights,
+        loss_scale=loss_scale, cast_model_outputs=cast_model_outputs)
+    scaler = LossScaler(
+        loss_scale=props.loss_scale,
+        min_loss_scale=min_loss_scale,
+        max_loss_scale=max_loss_scale)
+    if optimizer is None:
+        optimizer = optax.identity()
+    if verbosity > 0:
+        from apex_tpu.utils.logging import maybe_print
+        maybe_print(f"apex_tpu.amp configured: {props}")
+    return Amp(properties=props, scaler=scaler, tx=optimizer,
+               apply_fn=apply_fn, num_losses=num_losses,
+               keep_fp32_filter=keep_fp32_filter)
+
+
+def make_train_step(
+    amp: Amp,
+    loss_fn: Callable,
+    axis_name: Optional[str] = None,
+    reduce_fn: Optional[Callable[[Any], Any]] = None,
+    has_aux: bool = False,
+):
+    """Build a jittable single-loss train step.
+
+    ``loss_fn(model_params, *batch) -> loss`` (or ``(loss, aux)`` with
+    ``has_aux``) is evaluated at compute precision; the returned
+    ``step(state, *batch) -> (state, metrics)`` does forward, backward,
+    unscale, scaler update, and the conditional optimizer step in one
+    compiled graph (the whole of reference §3.2's hot loop).
+
+    ``axis_name`` applies a mean-``psum`` to the scaled grads (plain DP);
+    for the full knob set (predivide, fp32 wire, compression) pass
+    ``reduce_fn`` built by :func:`apex_tpu.parallel.ddp_reduce`.
+    """
+    if axis_name is not None and reduce_fn is None:
+        def reduce_fn(grads):
+            return jax.lax.pmean(grads, axis_name)
+
+    def step(state: AmpState, *batch):
+        params_c = amp.model_params(state)
+
+        def scaled_loss(p):
+            out = amp.run(loss_fn, p, *batch)
+            loss, aux = out if has_aux else (out, None)
+            return amp.scale_loss(loss, state), (loss, aux)
+
+        grads, (loss, aux) = jax.grad(scaled_loss, has_aux=True)(params_c)
+        new_state, info = amp.apply_gradients(state, grads,
+                                              reduce_fn=reduce_fn)
+        metrics = {"loss": loss, **info}
+        if has_aux:
+            metrics["aux"] = aux
+        return new_state, metrics
+
+    return step

@@ -1,0 +1,68 @@
+"""Casting policy tables — the O1 white/black/promote lists.
+
+Port of ``apex/amp/lists/{torch,functional,tensor}_overrides.py``.  The
+reference enumerates *torch* function names to monkey-patch; here the tables
+enumerate the ops this framework's policy-aware op layer
+(:mod:`apex_tpu.amp.ops`) exposes.  The categories and their members follow
+the reference:
+
+- ``HALF_OPS`` (reference ``FP16_FUNCS``, ``torch_overrides.py:7-26`` and
+  ``functional_overrides.py:18-27``): compute-bound MXU work — convolutions
+  and the BLAS family — which is both faster and accurate enough in 16-bit.
+- ``FP32_OPS`` (reference ``FP32_FUNCS``, ``torch_overrides.py:29-56`` and
+  ``functional_overrides.py:29-65``): pointwise transcendentals, reductions,
+  softmax/norms/losses — numerically sensitive, bandwidth-bound work kept in
+  fp32.
+- ``PROMOTE_OPS`` (reference ``CASTS``, ``torch_overrides.py:75-97``): binary
+  math that should run in the *widest* input type.  ``jnp`` already promotes
+  mixed bf16/fp32 operands to fp32, so these need no wrapper at all — the
+  table exists for documentation and for the conformance tests.
+- ``SEQUENCE_PROMOTE_OPS`` (reference ``SEQUENCE_CASTS``,
+  ``torch_overrides.py:100-103``): concatenate/stack of mixed-dtype lists.
+- ``BANNED_OPS`` (reference ``functional_overrides.py:67-77``): ops that are
+  numerically unsafe in 16-bit no matter what — binary cross entropy on
+  probabilities; use a with-logits formulation instead.
+"""
+
+HALF_OPS = [
+    # BLAS / matmul family (torch_overrides.py:7-26)
+    "matmul", "dot", "einsum", "dot_general", "tensordot",
+    # convolutions (functional_overrides.py:18-27)
+    "conv", "conv_general_dilated", "conv_transpose",
+    # linear layers
+    "linear", "prelu",
+]
+
+FP32_OPS = [
+    # transcendental pointwise (torch_overrides.py:29-56)
+    "acos", "asin", "cosh", "erfinv", "exp", "expm1", "log", "log10",
+    "log1p", "log2", "pow", "reciprocal", "rsqrt", "sinh", "tan",
+    # reductions
+    "cumprod", "cumsum", "sum", "prod", "mean", "var", "std", "norm",
+    "logsumexp",
+    # softmax / norms / losses (functional_overrides.py:29-65)
+    "softmax", "log_softmax", "softmin", "layer_norm", "group_norm",
+    "batch_norm", "cross_entropy", "nll_loss", "l1_loss", "mse_loss",
+    "smooth_l1_loss", "kl_div", "poisson_nll_loss", "cosine_embedding_loss",
+    "softplus",
+]
+
+PROMOTE_OPS = [
+    # binary math / comparison (torch_overrides.py:75-97) — jnp type
+    # promotion already yields widest-type behavior.
+    "add", "div", "mul", "sub", "atan2", "equal", "greater", "less",
+    "maximum", "minimum",
+]
+
+SEQUENCE_PROMOTE_OPS = ["concatenate", "stack"]  # torch_overrides.py:100-103
+
+BANNED_OPS = ["binary_cross_entropy"]  # functional_overrides.py:67-77
+
+BANNED_MESSAGE = (
+    "amp does not work out-of-the-box with binary_cross_entropy on "
+    "probabilities: the op requires inputs in [0,1] that a 16-bit sigmoid "
+    "cannot guarantee, and log(0) saturates. Use a *_with_logits loss "
+    "(sigmoid folded into the loss, computed in fp32) instead, or wrap the "
+    "call in apex_tpu.amp.disable_casts() if you accept the risk. "
+    "(Reference: apex/amp/lists/functional_overrides.py:67-77.)"
+)

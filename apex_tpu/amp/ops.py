@@ -1,0 +1,307 @@
+"""Policy-aware op layer — the TPU-native O1 casting engine.
+
+The reference implements O1 by monkey-patching ``torch`` / ``torch.nn.functional``
+/ ``torch.Tensor`` at runtime (``apex/amp/amp.py:68-177``, ``wrap.py:10-147``).
+JAX has no mutable eager namespace worth patching — everything is traced — so
+the same capability is delivered as:
+
+1. a **policy-aware op namespace** (this module): ``ops.matmul``, ``ops.conv``,
+   ``ops.softmax``, … consulted by this framework's layers.  Each op casts its
+   floating inputs per the tables in :mod:`apex_tpu.amp.lists` *when a cast
+   policy is active* (i.e. while tracing inside an O1 train step), and is a
+   transparent passthrough otherwise;
+2. **decorators/registrars for user functions** — :func:`half_function`,
+   :func:`float_function`, :func:`promote_function` and their ``register_*``
+   variants (reference ``apex/amp/__init__.py:1-4``, ``frontend.py:356-395``);
+3. :func:`cast_context` / :func:`disable_casts` context managers (reference
+   ``handle.py:159-163``).
+
+The reference's per-iteration cast cache (``utils.py:87-119``) has no analog
+here on purpose: repeated casts of the same parameter inside one trace are
+deduplicated by XLA CSE, which is exactly the memory/compute saving the cache
+bought in eager mode.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.amp import lists
+from apex_tpu.amp.policy import Properties
+
+
+class _CastState(threading.local):
+    def __init__(self):
+        self.policy: Optional[Properties] = None
+        self.disable_depth: int = 0
+
+
+_state = _CastState()
+
+
+def active_policy() -> Optional[Properties]:
+    """The policy in effect for op casting, or None."""
+    if _state.disable_depth > 0:
+        return None
+    p = _state.policy
+    if p is not None and p.enabled and p.cast_ops:
+        return p
+    return None
+
+
+@contextlib.contextmanager
+def cast_context(props: Properties):
+    """Activate O1 op casting for the dynamic extent (typically: while tracing
+    the loss function inside an O1 train step)."""
+    prev = _state.policy
+    _state.policy = props
+    try:
+        yield
+    finally:
+        _state.policy = prev
+
+
+@contextlib.contextmanager
+def disable_casts():
+    """Suspend op casting (reference ``handle.py:159-163``) — e.g. to run a
+    numerically sensitive user region in fp32 inside an O1 step."""
+    _state.disable_depth += 1
+    try:
+        yield
+    finally:
+        _state.disable_depth -= 1
+
+
+# ---------------------------------------------------------------------------
+# cast helpers
+# ---------------------------------------------------------------------------
+
+def _is_float_array(x: Any) -> bool:
+    return isinstance(x, (jax.Array, jnp.ndarray)) and jnp.issubdtype(
+        jnp.asarray(x).dtype, jnp.floating)
+
+
+def _cast_tree(tree: Any, dtype) -> Any:
+    """Cast every floating array leaf to ``dtype`` (reference ``utils.py``
+    ``casted_args``), leaving ints/bools/non-arrays untouched."""
+    def cast(x):
+        if _is_float_array(x) and jnp.asarray(x).dtype != dtype:
+            return jnp.asarray(x).astype(dtype)
+        return x
+    return jax.tree.map(cast, tree)
+
+
+def _widest_float(tree: Any):
+    """Widest floating dtype among array leaves (reference ``utils.py``
+    ``type_string`` + ``wrap.promote`` widest-type rule)."""
+    widest = None
+    for leaf in jax.tree.leaves(tree):
+        if _is_float_array(leaf):
+            dt = jnp.asarray(leaf).dtype
+            if widest is None or jnp.finfo(dt).bits > jnp.finfo(widest).bits:
+                widest = dt
+    return widest
+
+
+# ---------------------------------------------------------------------------
+# wrapper factories (reference wrap.py)
+# ---------------------------------------------------------------------------
+
+def half_function(fn: Callable) -> Callable:
+    """Run ``fn`` with floating inputs cast to the policy half dtype
+    (reference ``wrap.cached_cast`` → fp16, ``wrap.py:31-39``)."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        p = active_policy()
+        if p is None:
+            return fn(*args, **kwargs)
+        args, kwargs = _cast_tree((args, kwargs), p.half_dtype)
+        return fn(*args, **kwargs)
+    wrapper.__amp_wrapped__ = "half"
+    return wrapper
+
+
+def float_function(fn: Callable) -> Callable:
+    """Run ``fn`` with floating inputs cast to fp32 (reference
+    ``wrap.make_cast_wrapper`` on the blacklist)."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        p = active_policy()
+        if p is None:
+            return fn(*args, **kwargs)
+        args, kwargs = _cast_tree((args, kwargs), jnp.float32)
+        return fn(*args, **kwargs)
+    wrapper.__amp_wrapped__ = "float"
+    return wrapper
+
+
+def promote_function(fn: Callable) -> Callable:
+    """Run ``fn`` with floating inputs cast to the widest floating input type
+    (reference ``wrap.promote``, ``wrap.py:44-69``)."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if active_policy() is None:
+            return fn(*args, **kwargs)
+        widest = _widest_float((args, kwargs))
+        if widest is not None:
+            args, kwargs = _cast_tree((args, kwargs), widest)
+        return fn(*args, **kwargs)
+    wrapper.__amp_wrapped__ = "promote"
+    return wrapper
+
+
+def banned_function(fn: Callable, message: str = lists.BANNED_MESSAGE,
+                    allow_banned: bool = False) -> Callable:
+    """Raise if called with any half input under an active policy (reference
+    ``wrap.err_if_any_half``, ``wrap.py:114-147``)."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        p = active_policy()
+        if p is not None and not allow_banned:
+            for leaf in jax.tree.leaves((args, kwargs)):
+                if _is_float_array(leaf) and jnp.asarray(leaf).dtype == p.half_dtype:
+                    raise NotImplementedError(message)
+        return fn(*args, **kwargs)
+    wrapper.__amp_wrapped__ = "banned"
+    return wrapper
+
+
+# -- module-attribute registrars (reference apex/amp/frontend.py:356-395) ----
+
+_saved_registrations = []
+
+
+def _register(module: Any, name: str, maker: Callable[[Callable], Callable]):
+    orig = getattr(module, name)
+    if getattr(orig, "__amp_wrapped__", None) is not None:
+        return  # idempotent
+    _saved_registrations.append((module, name, orig))
+    setattr(module, name, maker(orig))
+
+
+def register_half_function(module: Any, name: str) -> None:
+    _register(module, name, half_function)
+
+
+def register_float_function(module: Any, name: str) -> None:
+    _register(module, name, float_function)
+
+
+def register_promote_function(module: Any, name: str) -> None:
+    _register(module, name, promote_function)
+
+
+def deactivate_registrations() -> None:
+    """Undo all ``register_*`` patches (reference ``AmpHandle._deactivate``,
+    ``handle.py:225-241``)."""
+    while _saved_registrations:
+        module, name, orig = _saved_registrations.pop()
+        setattr(module, name, orig)
+
+
+# ---------------------------------------------------------------------------
+# the policy-aware op namespace
+# ---------------------------------------------------------------------------
+# HALF_OPS — MXU-bound work cast to the half dtype.
+
+matmul = half_function(jnp.matmul)
+dot = half_function(jnp.dot)
+tensordot = half_function(jnp.tensordot)
+einsum = half_function(jnp.einsum)
+dot_general = half_function(lax.dot_general)
+conv_general_dilated = half_function(lax.conv_general_dilated)
+conv_transpose = half_function(lax.conv_transpose)
+
+
+def _linear(x, kernel, bias=None):
+    y = jnp.matmul(x, kernel)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+linear = half_function(_linear)
+
+# FP32_OPS — numerically sensitive work cast to fp32.
+
+exp = float_function(jnp.exp)
+expm1 = float_function(jnp.expm1)
+log = float_function(jnp.log)
+log1p = float_function(jnp.log1p)
+log2 = float_function(jnp.log2)
+log10 = float_function(jnp.log10)
+pow = float_function(jnp.power)  # noqa: A001 - mirrors reference list name
+reciprocal = float_function(jnp.reciprocal)
+rsqrt = float_function(lax.rsqrt)
+sinh = float_function(jnp.sinh)
+cosh = float_function(jnp.cosh)
+tan = float_function(jnp.tan)
+acos = float_function(jnp.arccos)
+asin = float_function(jnp.arcsin)
+erfinv = float_function(jax.scipy.special.erfinv)
+sum = float_function(jnp.sum)  # noqa: A001
+prod = float_function(jnp.prod)
+mean = float_function(jnp.mean)
+var = float_function(jnp.var)
+std = float_function(jnp.std)
+cumsum = float_function(jnp.cumsum)
+cumprod = float_function(jnp.cumprod)
+logsumexp = float_function(jax.scipy.special.logsumexp)
+softmax = float_function(jax.nn.softmax)
+log_softmax = float_function(jax.nn.log_softmax)
+softplus = float_function(jax.nn.softplus)
+
+
+def _norm(x, ord=None, axis=None, keepdims=False):
+    return jnp.linalg.norm(x, ord=ord, axis=axis, keepdims=keepdims)
+
+
+norm = float_function(_norm)
+
+# PROMOTE_OPS — jnp binary promotion already picks the widest type; exported
+# wrapped anyway so user code routed through ops.* is policy-auditable.
+
+add = promote_function(jnp.add)
+sub = promote_function(jnp.subtract)
+mul = promote_function(jnp.multiply)
+div = promote_function(jnp.divide)
+atan2 = promote_function(jnp.arctan2)
+maximum = promote_function(jnp.maximum)
+minimum = promote_function(jnp.minimum)
+
+# SEQUENCE_PROMOTE_OPS (reference wrap.sequence_promote, wrap.py:71-90)
+
+
+def _sequence_promote(fn):
+    @functools.wraps(fn)
+    def wrapper(arrays, *args, **kwargs):
+        if active_policy() is None:
+            return fn(arrays, *args, **kwargs)
+        widest = _widest_float(list(arrays))
+        if widest is not None:
+            arrays = [_cast_tree(a, widest) for a in arrays]
+        return fn(arrays, *args, **kwargs)
+    wrapper.__amp_wrapped__ = "sequence_promote"
+    return wrapper
+
+
+concatenate = _sequence_promote(jnp.concatenate)
+stack = _sequence_promote(jnp.stack)
+
+# BANNED_OPS
+
+
+def _binary_cross_entropy(probs, targets):
+    p = probs.astype(jnp.float32)
+    t = targets.astype(jnp.float32)
+    return -jnp.mean(t * jnp.log(p) + (1.0 - t) * jnp.log1p(-p))
+
+
+binary_cross_entropy = banned_function(_binary_cross_entropy)

@@ -1,0 +1,170 @@
+"""Optimization-level policy system.
+
+TPU-native equivalent of the reference's ``apex/amp/frontend.py:6-190``
+(``Properties`` + the ``O0``–``O3`` opt-level callables).  The reference
+routes an options dict through ``__setattr__`` consistency checks; here the
+policy is an immutable dataclass validated at construction, because under JAX
+the policy is applied once when the train step is built, not mutated at
+runtime.
+
+Differences from the reference, by design:
+
+- The "half" dtype defaults to ``bfloat16`` — the native TPU 16-bit format —
+  instead of ``float16``.  ``float16`` remains selectable for conformance
+  testing (``half_dtype=jnp.float16``).
+- ``patch_torch_functions`` becomes ``cast_ops``: there is no global namespace
+  to monkey-patch in JAX, so O1 is expressed as a policy-aware op layer
+  (:mod:`apex_tpu.amp.ops`) consulted by this package's own layers, plus a
+  registry for user functions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+from typing import Any, Optional, Union
+
+import jax.numpy as jnp
+
+#: Accepted spelling of a dynamic loss scale, as in the reference
+#: (``frontend.py:88-92`` accepts a float or the string ``"dynamic"``).
+DYNAMIC = "dynamic"
+
+
+def _parse_tristate(value: Union[None, bool, str], name: str) -> Optional[bool]:
+    """Parse ``None | bool | "True" | "False"`` like ``frontend.py:74-82``.
+
+    The reference deliberately accepts the *strings* "True"/"False" so that
+    argparse-produced values work unmodified; we keep that behavior.
+    """
+    if value is None or isinstance(value, bool):
+        return value
+    if isinstance(value, str):
+        if value == "True":
+            return True
+        if value == "False":
+            return False
+    raise ValueError(f"{name} must be None, a bool, or 'True'/'False'; got {value!r}")
+
+
+def _parse_loss_scale(value: Union[None, float, int, str]) -> Union[None, float, str]:
+    """Parse a loss scale: float, int, or the string "dynamic" (``frontend.py:88-92``)."""
+    if value is None or value == DYNAMIC:
+        return value
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"loss_scale must be a number or 'dynamic'; got {value!r}"
+        ) from None
+
+
+@dataclasses.dataclass(frozen=True)
+class Properties:
+    """Resolved mixed-precision options (reference ``frontend.py:6-96``).
+
+    Attributes:
+      enabled: master on/off switch; when False everything is a no-op
+        passthrough (reference ``_amp_state``/``frontend.py:204-230``).
+      opt_level: the selected level string, for logging.
+      cast_model_dtype: dtype the model params/compute are cast to (O2/O3), or
+        None to leave the model in fp32 (O0/O1).
+      cast_ops: O1-style policy casting of individual ops via
+        :mod:`apex_tpu.amp.ops` (reference ``patch_torch_functions``).
+      keep_batchnorm_fp32: keep normalization params/stats in fp32 when the
+        model is cast (reference semantics; only meaningful with
+        ``cast_model_dtype`` set).
+      master_weights: maintain fp32 master params and run the optimizer on
+        them (reference ``master_weights``).
+      loss_scale: float for a static scale, or ``"dynamic"``.
+      half_dtype: the 16-bit compute dtype (bfloat16 on TPU by default).
+      cast_model_outputs: if set, model outputs are cast to this dtype instead
+        of fp32 (reference ``frontend.py:194`` kwarg).
+    """
+
+    enabled: bool = True
+    opt_level: str = "O1"
+    cast_model_dtype: Optional[Any] = None
+    cast_ops: bool = True
+    keep_batchnorm_fp32: Optional[bool] = None
+    master_weights: Optional[bool] = None
+    loss_scale: Union[float, str] = DYNAMIC
+    half_dtype: Any = jnp.bfloat16
+    cast_model_outputs: Optional[Any] = None
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "keep_batchnorm_fp32",
+            _parse_tristate(self.keep_batchnorm_fp32, "keep_batchnorm_fp32"))
+        object.__setattr__(self, "loss_scale", _parse_loss_scale(self.loss_scale))
+        # Consistency checks mirroring frontend.py:54-82.
+        if self.cast_ops and self.cast_model_dtype is not None:
+            warnings.warn(
+                "O1-style op casting (cast_ops=True) together with a cast model "
+                "dtype is unusual; O1 expects the model left in fp32 "
+                "(reference frontend.py:54-63)."
+            )
+        if self.keep_batchnorm_fp32 and self.cast_model_dtype is None:
+            warnings.warn(
+                "keep_batchnorm_fp32 has no effect when the model is not cast "
+                "(reference frontend.py:66-72)."
+            )
+
+    @property
+    def is_dynamic_loss_scale(self) -> bool:
+        return self.loss_scale == DYNAMIC
+
+    def replace(self, **kw) -> "Properties":
+        return dataclasses.replace(self, **kw)
+
+
+def O0(half_dtype=jnp.bfloat16) -> Properties:
+    """Pure fp32 (reference ``frontend.py:174-184``)."""
+    return Properties(
+        opt_level="O0", cast_model_dtype=jnp.float32, cast_ops=False,
+        keep_batchnorm_fp32=None, master_weights=False, loss_scale=1.0,
+        half_dtype=half_dtype)
+
+
+def O1(half_dtype=jnp.bfloat16) -> Properties:
+    """Policy-cast ops, fp32 model, dynamic scale (reference ``frontend.py:155-165``)."""
+    return Properties(
+        opt_level="O1", cast_model_dtype=None, cast_ops=True,
+        keep_batchnorm_fp32=None, master_weights=None, loss_scale=DYNAMIC,
+        half_dtype=half_dtype)
+
+
+def O2(half_dtype=jnp.bfloat16) -> Properties:
+    """Half model + fp32 norm layers + fp32 masters + dynamic scale
+    (reference ``frontend.py:133-143``)."""
+    return Properties(
+        opt_level="O2", cast_model_dtype=half_dtype, cast_ops=False,
+        keep_batchnorm_fp32=True, master_weights=True, loss_scale=DYNAMIC,
+        half_dtype=half_dtype)
+
+
+def O3(half_dtype=jnp.bfloat16) -> Properties:
+    """Pure half "speed of light" mode (reference ``frontend.py:110-120``)."""
+    return Properties(
+        opt_level="O3", cast_model_dtype=half_dtype, cast_ops=False,
+        keep_batchnorm_fp32=False, master_weights=False, loss_scale=1.0,
+        half_dtype=half_dtype)
+
+
+opt_levels = {"O0": O0, "O1": O1, "O2": O2, "O3": O3}
+
+
+def resolve(opt_level: str = "O1",
+            half_dtype=jnp.bfloat16,
+            enabled: bool = True,
+            **overrides) -> Properties:
+    """Select an opt level then apply explicit per-kwarg overrides, the
+    resolution order of the reference (``frontend.py:307-347``)."""
+    if opt_level not in opt_levels:
+        raise ValueError(
+            f"Unexpected optimization level {opt_level!r}; options are "
+            "'O0', 'O1', 'O2', 'O3' (the letter O, not zero).")
+    props = opt_levels[opt_level](half_dtype=half_dtype)
+    overrides = {k: v for k, v in overrides.items() if v is not None}
+    props = props.replace(enabled=enabled, **overrides)
+    return props

@@ -1,0 +1,136 @@
+"""Dtype-conversion and master-param utilities.
+
+Port of ``apex/fp16_utils/fp16util.py``.  PyTorch modules become param
+pytrees: "convert the network" means casting leaves, "prep param lists" means
+building an fp32 master copy (optionally flattened into a single vector —
+the reference's ``flat_master`` mode, ``fp16util.py:90-133``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.frontend import default_keep_fp32_filter
+from apex_tpu.multi_tensor_apply import multi_tensor_applier
+from apex_tpu.ops.multi_tensor import multi_tensor_scale
+
+
+def _is_float(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def tree_to_half(params: Any, half_dtype=jnp.bfloat16) -> Any:
+    """Cast every floating leaf to the half dtype (reference ``tofp16`` /
+    ``network_to_half``, ``fp16util.py:7-41``)."""
+    return jax.tree.map(
+        lambda x: x.astype(half_dtype) if _is_float(x) else x, params)
+
+
+def tree_to_float(params: Any) -> Any:
+    """Cast every floating leaf to fp32 (reference ``convert_module(float)``)."""
+    return jax.tree.map(
+        lambda x: x.astype(jnp.float32) if _is_float(x) else x, params)
+
+
+def convert_network(params: Any, dtype,
+                    keep_fp32_filter: Callable = default_keep_fp32_filter) -> Any:
+    """Batchnorm-safe network conversion (reference ``convert_network``,
+    ``fp16util.py:44-70``): cast floating leaves to ``dtype`` except params on
+    normalization paths, which stay fp32."""
+    def cast(path, x):
+        if not _is_float(x):
+            return x
+        if keep_fp32_filter(path):
+            return x.astype(jnp.float32)
+        return x.astype(dtype)
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+# alias matching the reference name for the BN-only piece
+def BN_convert_float(params: Any,
+                     keep_fp32_filter: Callable = default_keep_fp32_filter) -> Any:
+    """Force normalization-path leaves back to fp32 (``fp16util.py:22-32``)."""
+    def cast(path, x):
+        if _is_float(x) and keep_fp32_filter(path):
+            return x.astype(jnp.float32)
+        return x
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def prep_param_lists(params: Any, flat_master: bool = False
+                     ) -> Tuple[Any, Any]:
+    """Build (model_params, master_params) (reference ``prep_param_lists``,
+    ``fp16util.py:90-133``).
+
+    With ``flat_master`` the fp32 master is a single flat vector — the memory
+    layout the fused flat-buffer optimizer uses.  Returns
+    ``(params, master)`` where ``master`` is either a matching pytree of fp32
+    leaves or ``(flat_vector, unravel_fn)``.
+    """
+    if flat_master:
+        leaves, treedef = jax.tree.flatten(params)
+        float_idx = [i for i, l in enumerate(leaves) if _is_float(l)]
+        if not float_idx:
+            raise ValueError("no floating params to build a flat master from")
+        flat = jnp.concatenate([jnp.ravel(leaves[i]).astype(jnp.float32)
+                                for i in float_idx])
+        shapes = [leaves[i].shape for i in float_idx]
+
+        def unravel(vec):
+            # Non-float leaves (step counters, index tables) pass through
+            # unchanged; only float leaves live in the flat master.
+            out = list(leaves)
+            off = 0
+            for i, s in zip(float_idx, shapes):
+                n = 1
+                for d in s:
+                    n *= d
+                out[i] = vec[off:off + n].reshape(s)
+                off += n
+            return jax.tree.unflatten(treedef, out)
+
+        return params, (flat, unravel)
+    master = tree_to_float(params)
+    return params, master
+
+
+def model_grads_to_master_grads(model_grads: Any) -> Any:
+    """fp16 model grads → fp32 master grads in one fused pass
+    (``fp16util.py:136-154``)."""
+    leaves, treedef = jax.tree.flatten(model_grads)
+    outs, _ = multi_tensor_applier(multi_tensor_scale, [leaves], 1.0,
+                                   out_dtype=jnp.float32)
+    return jax.tree.unflatten(treedef, outs)
+
+
+def master_params_to_model_params(master_params: Any, model_dtype) -> Any:
+    """fp32 masters → model-dtype params in one fused pass
+    (``fp16util.py:157-172``)."""
+    leaves, treedef = jax.tree.flatten(master_params)
+    outs, _ = multi_tensor_applier(multi_tensor_scale, [leaves], 1.0,
+                                   out_dtype=model_dtype)
+    return jax.tree.unflatten(treedef, outs)
+
+
+def to_python_float(t) -> float:
+    """Host-side scalar extraction (``fp16util.py:176-180``).  This *is* a
+    device sync — never call it inside the hot loop."""
+    return float(jax.device_get(t))
+
+
+def clip_grad_norm(grads: Any, max_norm: float, norm_type: float = 2.0
+                   ) -> Tuple[Any, jax.Array]:
+    """Global-norm gradient clipping (reference re-exports torch's
+    ``clip_grad_norm``, ``fp16util.py:182-187``).  Returns (clipped, norm)."""
+    leaves = jax.tree.leaves(grads)
+    if norm_type == 2.0:
+        norm = jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                            for l in leaves))
+    else:
+        norm = sum(jnp.sum(jnp.abs(l.astype(jnp.float32)) ** norm_type)
+                   for l in leaves) ** (1.0 / norm_type)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda l: (l * scale).astype(l.dtype), grads), norm

@@ -1,0 +1,87 @@
+"""Legacy loss scalers.
+
+Port of ``apex/fp16_utils/loss_scaler.py``: the static ``LossScaler``
+(``:10-45``) and ``DynamicLossScaler`` (``:47-132``, init ``2**32``, factor 2,
+window 1000 — note these legacy defaults differ from amp's scaler).  Kept for
+API parity with the reference's deprecated-but-present surface; new code
+should use :class:`apex_tpu.amp.LossScaler`, whose state lives on device.
+
+These legacy classes are *host-side stateful* like the originals: calling
+:meth:`update_scale` with a host boolean mutates Python attributes.  That is
+only usable outside jit (e.g. in eager experimentation loops).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaler:
+    """Static loss scaler (``loss_scaler.py:10-45``)."""
+
+    def __init__(self, scale: float = 1.0):
+        self.cur_scale = float(scale)
+
+    def has_overflow(self, params) -> bool:
+        return False
+
+    @property
+    def loss_scale(self) -> float:
+        return self.cur_scale
+
+    def scale_gradient(self, grads):
+        return jax.tree.map(lambda g: g * self.loss_scale, grads)
+
+    def backward(self, loss_fn, params, *args):
+        """Gradient of ``loss * scale`` (the eager analog of
+        ``scaled_loss.backward()``)."""
+        return jax.grad(
+            lambda p: loss_fn(p, *args).astype(jnp.float32) * self.loss_scale
+        )(params)
+
+    def update_scale(self, overflow: bool) -> None:
+        pass
+
+
+class DynamicLossScaler:
+    """Dynamic legacy scaler (``loss_scaler.py:47-132``)."""
+
+    def __init__(self, init_scale: float = 2.0 ** 32, scale_factor: float = 2.0,
+                 scale_window: int = 1000):
+        self.cur_scale = float(init_scale)
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+
+    def has_overflow(self, grads: Any) -> bool:
+        """Host-side per-param overflow scan (``loss_scaler.py:57-76``)."""
+        for leaf in jax.tree.leaves(grads):
+            if not bool(jnp.isfinite(leaf).all()):
+                return True
+        return False
+
+    @property
+    def loss_scale(self) -> float:
+        return self.cur_scale
+
+    def scale_gradient(self, grads):
+        return jax.tree.map(lambda g: g * self.loss_scale, grads)
+
+    def backward(self, loss_fn, params, *args):
+        return jax.grad(
+            lambda p: loss_fn(p, *args).astype(jnp.float32) * self.loss_scale
+        )(params)
+
+    def update_scale(self, overflow: bool) -> None:
+        """(``loss_scaler.py:94-110``): halve on overflow; double after
+        ``scale_window`` clean iterations."""
+        if overflow:
+            self.cur_scale = max(self.cur_scale / self.scale_factor, 1.0)
+            self.last_overflow_iter = self.cur_iter
+        elif (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0:
+            self.cur_scale *= self.scale_factor
+        self.cur_iter += 1

@@ -1,0 +1,5 @@
+"""apex_tpu.models — reference workload model families (BASELINE configs)."""
+
+from apex_tpu.models.mlp import MLP, AmpDense, cross_entropy_loss
+
+__all__ = ["MLP", "AmpDense", "cross_entropy_loss"]

@@ -1,0 +1,56 @@
+"""MNIST-scale MLP — the minimal end-to-end workload.
+
+Port of BASELINE config 1 ("examples/simple amp O1 MNIST MLP").  The layers
+route their matmuls through :mod:`apex_tpu.amp.ops` so the O1 policy governs
+their precision exactly as the reference's monkey-patched ``torch.nn.functional
+.linear`` did.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.amp import ops as amp_ops
+
+
+class AmpDense(nn.Module):
+    """Dense layer whose matmul is policy-cast (O1 whitelists ``linear``,
+    reference ``functional_overrides.py:18-27``)."""
+
+    features: int
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (x.shape[-1], self.features), jnp.float32)
+        bias = (self.param("bias", nn.initializers.zeros,
+                           (self.features,), jnp.float32)
+                if self.use_bias else None)
+        return amp_ops.linear(x, kernel, bias)
+
+
+class MLP(nn.Module):
+    """ReLU MLP classifier."""
+
+    features: Sequence[int] = (256, 256)
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        for f in self.features:
+            x = AmpDense(f)(x)
+            x = nn.relu(x)
+        return AmpDense(self.num_classes)(x)
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Softmax cross entropy in fp32 (O1 blacklists softmax/losses)."""
+    logp = amp_ops.log_softmax(logits, axis=-1)
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=logp.dtype)
+    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))

@@ -1,0 +1,6 @@
+from apex_tpu.multi_tensor_apply.multi_tensor_apply import (
+    MultiTensorApply,
+    multi_tensor_applier,
+)
+
+__all__ = ["MultiTensorApply", "multi_tensor_applier"]

@@ -1,0 +1,30 @@
+"""The multi-tensor applier singleton.
+
+Port of ``apex/multi_tensor_apply/multi_tensor_apply.py:3-30``: a callable
+holding the chunk size, applied as ``multi_tensor_applier(op, tensor_lists,
+*args)``.  Differences forced by functional JAX:
+
+- no ``noop_flag_buffer`` argument — ops *return* the overflow flag instead
+  of writing into a caller-owned buffer;
+- ``available`` is always True: the fused path has no optional native build
+  (the Pallas/jnp choice is made inside each op, see
+  :mod:`apex_tpu.ops`).
+"""
+
+from __future__ import annotations
+
+from apex_tpu.ops.multi_tensor import DEFAULT_CHUNK_SIZE
+
+
+class MultiTensorApply:
+    available = True
+    import_err = None
+
+    def __init__(self, chunk_size: int = DEFAULT_CHUNK_SIZE):
+        self.chunk_size = int(chunk_size)
+
+    def __call__(self, op, tensor_lists, *args, **kwargs):
+        return op(self.chunk_size, tensor_lists, *args, **kwargs)
+
+
+multi_tensor_applier = MultiTensorApply(DEFAULT_CHUNK_SIZE)

@@ -1,0 +1,10 @@
+"""apex_tpu.normalization — fused normalization layers
+(reference ``apex/normalization/__init__.py`` exports ``FusedLayerNorm``)."""
+
+from apex_tpu.normalization.fused_layer_norm import (
+    FusedLayerNorm,
+    fused_layer_norm,
+    fused_layer_norm_affine,
+)
+
+__all__ = ["FusedLayerNorm", "fused_layer_norm", "fused_layer_norm_affine"]

@@ -1,0 +1,36 @@
+"""apex_tpu.ops — fused TPU kernels (Pallas) and their jnp reference paths.
+
+Layer L1/L2 of the design (SURVEY.md §7): every op here has
+(a) a pure ``jax.numpy`` reference implementation — always correct, used on
+    CPU and as the conformance oracle (the analog of the reference's
+    Python-fallback paths), and
+(b) a Pallas TPU kernel used on TPU for explicit single-pass fusion control
+    (the analog of ``csrc/``).
+
+Selection is automatic (`on_tpu()`), overridable via the environment variable
+``APEX_TPU_KERNELS={pallas,jnp,auto}`` for A/B conformance testing — the port
+of the reference L1 harness's ext-vs-no-ext install axis
+(``tests/L1/common/run_test.sh``).
+"""
+
+import os
+
+import jax
+
+
+def kernel_mode() -> str:
+    """'pallas' | 'jnp' | 'auto' from APEX_TPU_KERNELS (default auto)."""
+    return os.environ.get("APEX_TPU_KERNELS", "auto")
+
+
+def on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def use_pallas() -> bool:
+    mode = kernel_mode()
+    if mode == "pallas":
+        return True
+    if mode == "jnp":
+        return False
+    return on_tpu()

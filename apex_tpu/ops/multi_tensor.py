@@ -1,0 +1,166 @@
+"""Functional multi-tensor ops: scale / axpby / l2norm over tensor lists.
+
+Port of the ``amp_C`` extension surface (``csrc/amp_C_frontend.cpp:43-54``):
+
+- :func:`multi_tensor_scale` — fused copy × scale + overflow flag
+  (``multi_tensor_scale_kernel.cu``); this is the engine of gradient
+  unscaling (``apex/amp/scaler.py:113-116``) and master→model copies.
+- :func:`multi_tensor_axpby` — ``out = a·x + b·y`` with a selectable
+  inf-check argument (``multi_tensor_axpby_kernel.cu``); the
+  gradient-accumulation path.
+- :func:`multi_tensor_l2norm` — global and optional per-tensor L2 norms
+  (``multi_tensor_l2norm_kernel.cu``).
+
+JAX is functional, so instead of writing into output lists these return new
+lists; the "overflow buffer" becomes a returned int32 flag (monotonic OR
+across chunks, like the racy-but-monotonic CUDA flag writes —
+``multi_tensor_scale_kernel.cu:71``).  Mixed-dtype input lists are grouped by
+dtype and processed one packed launch per group (the analog of
+``split_by_type``, ``apex/parallel/distributed.py:62-72``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.ops import packing, use_pallas
+from apex_tpu.ops.pallas import multi_tensor_kernels as ker
+
+#: Default chunk size, matching the reference applier
+#: (``apex/multi_tensor_apply/__init__.py:3``: 2048*32).
+DEFAULT_CHUNK_SIZE = 2048 * 32
+
+
+def _resolve_out_dtype(tensor_lists, out_dtype):
+    if out_dtype is not None:
+        return out_dtype
+    if len(tensor_lists) > 1 and tensor_lists[-1]:
+        t0 = tensor_lists[-1][0]
+        return getattr(t0, "dtype", jnp.result_type(t0))
+    return None  # same as input
+
+
+def multi_tensor_scale(
+    chunk_size: int,
+    tensor_lists: Sequence[Sequence[jax.Array]],
+    scale: Any,
+    out_dtype=None,
+) -> Tuple[List[jax.Array], jax.Array]:
+    """``outs[i] = ins[i] * scale`` (cast to ``out_dtype``) + overflow flag.
+
+    ``tensor_lists`` is ``[ins]`` or ``[ins, out_templates]`` — the second
+    list, when given, only supplies the output dtype, mirroring the reference
+    call shape ``[model_grads, master_grads]`` (``scaler.py:113-116``).
+    Returns ``(outs, overflow_flag)`` with ``overflow_flag`` an int32 scalar
+    (0 = all finite).
+    """
+    ins = list(tensor_lists[0])
+    odt = _resolve_out_dtype(tensor_lists, out_dtype)
+    scale = jnp.asarray(scale, jnp.float32)
+    if not ins:
+        return [], jnp.zeros((), jnp.int32)
+
+    outs: List[Optional[jax.Array]] = [None] * len(ins)
+    flag = jnp.zeros((), jnp.int32)
+    for dtype, idxs in packing.group_by_dtype(ins).items():
+        group = [ins[i] for i in idxs]
+        godt = odt or dtype
+        if use_pallas() and ker.chunk_supported(chunk_size):
+            flat, meta = packing.pack(group, chunk_size)
+            out_flat, gflag = ker.packed_scale(flat, scale, chunk_size, godt)
+            gouts = packing.unpack(out_flat, meta)
+        else:
+            f32 = [t.astype(jnp.float32) for t in group]
+            gouts = [(t * scale).astype(godt) for t in f32]
+            finite = jnp.stack([jnp.isfinite(t).all() for t in f32]).all()
+            gflag = jnp.where(finite, 0, 1).astype(jnp.int32)
+        for i, o in zip(idxs, gouts):
+            outs[i] = o
+        flag = jnp.maximum(flag, gflag)
+    return outs, flag
+
+
+def multi_tensor_axpby(
+    chunk_size: int,
+    tensor_lists: Sequence[Sequence[jax.Array]],
+    a: Any,
+    b: Any,
+    arg_to_check: int = -1,
+    out_dtype=None,
+) -> Tuple[List[jax.Array], jax.Array]:
+    """``outs[i] = a*xs[i] + b*ys[i]`` + overflow flag on the selected arg.
+
+    ``tensor_lists = [xs, ys]`` or ``[xs, ys, out_templates]``
+    (reference ``scaler.py:167-172`` passes [model, stashed, master] with
+    ``arg_to_check=0`` so stale stashed grads can't spuriously trip the flag).
+    """
+    xs, ys = list(tensor_lists[0]), list(tensor_lists[1])
+    assert len(xs) == len(ys)
+    odt = _resolve_out_dtype(tensor_lists, out_dtype) if len(tensor_lists) > 2 \
+        else out_dtype
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    if not xs:
+        return [], jnp.zeros((), jnp.int32)
+
+    outs: List[Optional[jax.Array]] = [None] * len(xs)
+    flag = jnp.zeros((), jnp.int32)
+    for dtype, idxs in packing.group_by_dtype(xs).items():
+        gx = [xs[i] for i in idxs]
+        gy = [ys[i] for i in idxs]
+        godt = odt or dtype
+        if use_pallas() and ker.chunk_supported(chunk_size):
+            xf, meta = packing.pack(gx, chunk_size)
+            # y packs in fp32: the accumulator side must not lose precision
+            # (the jnp path below also computes in fp32).
+            yf, _ = packing.pack([t.astype(jnp.float32) for t in gy],
+                                 chunk_size)
+            out_flat, gflag = ker.packed_axpby(xf, yf, a, b, chunk_size, godt,
+                                               arg_to_check=arg_to_check)
+            gouts = packing.unpack(out_flat, meta)
+        else:
+            xs32 = [t.astype(jnp.float32) for t in gx]
+            ys32 = [t.astype(jnp.float32) for t in gy]
+            gouts = [(a * x + b * y).astype(godt) for x, y in zip(xs32, ys32)]
+            checks = []
+            if arg_to_check in (-1, 0):
+                checks += [jnp.isfinite(x).all() for x in xs32]
+            if arg_to_check in (-1, 1):
+                checks += [jnp.isfinite(y).all() for y in ys32]
+            finite = jnp.stack(checks).all()
+            gflag = jnp.where(finite, 0, 1).astype(jnp.int32)
+        for i, o in zip(idxs, gouts):
+            outs[i] = o
+        flag = jnp.maximum(flag, gflag)
+    return outs, flag
+
+
+def multi_tensor_l2norm(
+    chunk_size: int,
+    tensor_lists: Sequence[Sequence[jax.Array]],
+    per_tensor: bool = False,
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Global L2 norm over a tensor list, optionally per-tensor norms too
+    (``multi_tensor_l2norm_kernel.cu:117-180`` returns both).
+
+    Per-tensor norms are computed as per-leaf fp32 reductions (XLA emits a
+    tight reduction per tensor); the packed Pallas path accelerates only the
+    *global* norm where one pass over the flat buffer wins.
+    """
+    ins = list(tensor_lists[0])
+    if not ins:
+        z = jnp.zeros((), jnp.float32)
+        return z, (jnp.zeros((0,), jnp.float32) if per_tensor else None)
+
+    if use_pallas() and not per_tensor and ker.chunk_supported(chunk_size):
+        total = jnp.zeros((), jnp.float32)
+        for dtype, idxs in packing.group_by_dtype(ins).items():
+            flat, _ = packing.pack([ins[i] for i in idxs], chunk_size)
+            total = total + ker.packed_sumsq(flat, chunk_size)
+        return jnp.sqrt(total), None
+    per = jnp.stack([jnp.sum(jnp.square(t.astype(jnp.float32))) for t in ins])
+    return jnp.sqrt(per.sum()), (jnp.sqrt(per) if per_tensor else None)

@@ -1,0 +1,73 @@
+"""Flat-buffer packing for multi-tensor ops.
+
+The reference's key perf trick is ``multi_tensor_apply`` — packing pointers
+for hundreds of small tensors into kernel argument space so one launch
+processes them all (``csrc/multi_tensor_apply.cuh:14-125``).  TPU has no
+per-launch overhead crisis, but touching hundreds of small HBM buffers in
+separate fusions still wastes bandwidth; the TPU-native analog (SURVEY.md §7
+"multi_tensor_apply economics") is to **concatenate the tensors into one flat
+HBM buffer** padded to a chunk multiple, run a single Pallas grid over the
+chunks, and slice the results back out.  The (sizes, offsets) metadata plays
+the role of ``TensorListMetadata``.
+
+Under ``jit`` the concatenate / slice pair is pure data movement that XLA
+schedules once; for steady-state optimizer use the packed representation can
+be kept across steps (see ``apex_tpu.optimizers.FP16Optimizer``, the analog
+of the reference's flat-buffer ``apex/optimizers/fp16_optimizer.py:57-70``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PackMeta(NamedTuple):
+    """Static metadata describing a packed tensor list."""
+
+    shapes: Tuple[Tuple[int, ...], ...]
+    sizes: Tuple[int, ...]
+    offsets: Tuple[int, ...]   # start offset of each tensor in the flat buffer
+    total: int                 # unpadded total element count
+    padded: int                # padded total (multiple of chunk)
+    dtype: Any
+
+
+def pack(tensors: Sequence[jax.Array], chunk_size: int) -> Tuple[jax.Array, PackMeta]:
+    """Concatenate raveled tensors into one flat buffer padded to a multiple
+    of ``chunk_size`` (pad value 0 — finite, so it never trips the overflow
+    flag, matching the reference kernels which simply don't read past
+    ``chunk_size`` remainders)."""
+    assert len(tensors) > 0
+    dtype = tensors[0].dtype
+    sizes = tuple(int(np.prod(t.shape)) if t.shape else 1 for t in tensors)
+    offsets = tuple(int(o) for o in np.cumsum((0,) + sizes[:-1]))
+    total = int(sum(sizes))
+    padded = int(-(-max(total, 1) // chunk_size) * chunk_size)
+    flat = jnp.concatenate([jnp.ravel(t) for t in tensors])
+    if padded != total:
+        flat = jnp.pad(flat, (0, padded - total))
+    meta = PackMeta(shapes=tuple(t.shape for t in tensors), sizes=sizes,
+                    offsets=offsets, total=total, padded=padded, dtype=dtype)
+    return flat, meta
+
+
+def unpack(flat: jax.Array, meta: PackMeta) -> List[jax.Array]:
+    """Slice a flat buffer back into the original shapes."""
+    out = []
+    for shape, size, offset in zip(meta.shapes, meta.sizes, meta.offsets):
+        out.append(jax.lax.dynamic_slice_in_dim(flat, offset, size).reshape(shape))
+    return out
+
+
+def group_by_dtype(tensors: Sequence[jax.Array]):
+    """Indices grouped by dtype — the analog of the reference's
+    ``split_by_type`` bucketing (``apex/parallel/distributed.py:62-72``);
+    packed kernels run once per dtype group."""
+    groups = {}
+    for i, t in enumerate(tensors):
+        groups.setdefault(jnp.asarray(t).dtype, []).append(i)
+    return groups

@@ -1,0 +1,108 @@
+"""Pallas TPU kernel for the fused Adam update.
+
+TPU-native equivalent of ``csrc/fused_adam_cuda_kernel.cu:20-56``: one pass
+over packed flat (p, m, v, g) buffers doing descale → moment update →
+(eps-in/out-sqrt) → weight update → half-precision param writeback.  The
+CUDA kernel grid-strides with ILP=4; here the flat buffers are viewed as
+``(rows, LANES)`` and a sequential grid walks row-blocks, each block one VMEM
+tile per operand.  ``step_size`` (with bias correction precomputed outside,
+as in ``fused_adam_cuda_kernel.cu:83-91``), ``scale``, and ``weight_decay``
+arrive as SMEM scalars so a changing loss scale never triggers recompilation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops import on_tpu
+
+#: Flat buffers must be padded to a multiple of this (8 sublanes × 128 lanes
+#: × 8 rows of work per tile keeps every operand a well-formed fp32 tile).
+ADAM_PAD = 8 * 1024
+
+
+def _adam_kernel(scalars_ref, p_ref, m_ref, v_ref, g_ref,
+                 out_p_ref, out_m_ref, out_v_ref, *rest, eps_mode):
+    step_size = scalars_ref[0]
+    beta1 = scalars_ref[1]
+    beta2 = scalars_ref[2]
+    eps = scalars_ref[3]
+    inv_scale = scalars_ref[4]
+    weight_decay = scalars_ref[5]
+
+    p = p_ref[...].astype(jnp.float32)
+    m = m_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32) * inv_scale
+    g = g + weight_decay * p
+    m = beta1 * m + (1.0 - beta1) * g
+    v = beta2 * v + (1.0 - beta2) * g * g
+    if eps_mode == 1:  # eps inside sqrt
+        denom = jnp.sqrt(v + eps)
+    else:
+        denom = jnp.sqrt(v) + eps
+    p = p - step_size * m / denom
+    out_p_ref[...] = p.astype(out_p_ref.dtype)
+    out_m_ref[...] = m.astype(out_m_ref.dtype)
+    out_v_ref[...] = v.astype(out_v_ref.dtype)
+    if rest:  # optional half p_copy (the fused fp16 writeback)
+        rest[0][...] = p.astype(rest[0].dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("beta1", "beta2", "eps", "weight_decay", "eps_mode",
+                     "p_copy_dtype"))
+def packed_adam(p: jax.Array, m: jax.Array, v: jax.Array, g: jax.Array,
+                *, step_size, beta1: float, beta2: float, eps: float,
+                scale, weight_decay: float, eps_mode: int,
+                p_copy_dtype=None):
+    """Fused Adam over flat buffers padded to a multiple of ``ADAM_PAD``.
+
+    Returns ``(new_p, new_m, new_v)`` or ``(..., p_copy)`` when
+    ``p_copy_dtype`` is set.
+    """
+    n = p.shape[0]
+    assert n % ADAM_PAD == 0, f"pad flat buffers to {ADAM_PAD} (got {n})"
+    lanes = 1024
+    rows = n // lanes
+    block_rows = 8
+    grid = rows // block_rows
+
+    scalars = jnp.stack([
+        jnp.asarray(step_size, jnp.float32),
+        jnp.asarray(beta1, jnp.float32),
+        jnp.asarray(beta2, jnp.float32),
+        jnp.asarray(eps, jnp.float32),
+        1.0 / jnp.asarray(scale, jnp.float32),
+        jnp.asarray(weight_decay, jnp.float32),
+    ])
+
+    def spec():
+        return pl.BlockSpec((block_rows, lanes), lambda i: (i, 0))
+
+    out_shape = [
+        jax.ShapeDtypeStruct((rows, lanes), p.dtype),
+        jax.ShapeDtypeStruct((rows, lanes), m.dtype),
+        jax.ShapeDtypeStruct((rows, lanes), v.dtype),
+    ]
+    out_specs = [spec(), spec(), spec()]
+    if p_copy_dtype is not None:
+        out_shape.append(jax.ShapeDtypeStruct((rows, lanes), p_copy_dtype))
+        out_specs.append(spec())
+
+    outs = pl.pallas_call(
+        functools.partial(_adam_kernel, eps_mode=eps_mode),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  spec(), spec(), spec(), spec()],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=not on_tpu(),
+    )(scalars, *(t.reshape(rows, lanes) for t in (p, m, v, g)))
+    return tuple(o.reshape(-1) for o in outs)

@@ -1,0 +1,205 @@
+"""Pallas TPU kernels for FusedLayerNorm forward/backward.
+
+TPU-native equivalent of ``csrc/layer_norm_cuda_kernel.cu``:
+
+- forward (``cuApplyLayerNorm``, ``:279-324``): per-row (μ, 1/σ) in fp32 —
+  the Welford/Chan warp dance collapses to a VPU row reduction — then the
+  elementwise normalize + affine, saving (mean, invvar) as residuals exactly
+  like the CUDA host side (``layer_norm_cuda.cpp:132,154``).
+- backward: the CUDA version splits γ/β grads into a two-stage reduction
+  (``cuComputePartGradGammaBeta``/``cuComputeGradGammaBeta``, ``:404-522``)
+  plus ``cuComputeGradInput`` (``:523-640``).  Here one kernel computes
+  ``dx`` per row-block and *accumulates* ``dγ``/``dβ`` partials across the
+  sequential TPU grid into a single output tile — the grid itself is the
+  second reduction stage.
+
+Rows are padded to a block multiple in the wrapper (padded rows produce
+garbage stats that are sliced away; they cannot NaN because the input pad is
+zeros and eps > 0).  Feature dims not divisible by 128 fall back to the jnp
+path at the call site (`supported`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops import on_tpu
+
+_BLOCK_ROWS = 128
+
+
+def supported(n2: int) -> bool:
+    return n2 % 128 == 0 and n2 <= 16384
+
+
+def _fwd_kernel(x_ref, w_ref, b_ref, y_ref, mean_ref, inv_ref, *, eps,
+                affine):
+    x = x_ref[...].astype(jnp.float32)
+    mean = x.mean(axis=1, keepdims=True)
+    xc = x - mean
+    var = (xc * xc).mean(axis=1, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    y = xc * inv
+    if affine:
+        y = y * w_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+    y_ref[...] = y.astype(y_ref.dtype)
+    mean_ref[...] = mean
+    inv_ref[...] = inv
+
+
+def _bwd_kernel(dy_ref, x_ref, w_ref, mean_ref, inv_ref,
+                dx_ref, dw_ref, db_ref, *, affine):
+    i = pl.program_id(0)
+    dy = dy_ref[...].astype(jnp.float32)
+    x = x_ref[...].astype(jnp.float32)
+    mean = mean_ref[...]
+    inv = inv_ref[...]
+    xhat = (x - mean) * inv
+    if affine:
+        wdy = dy * w_ref[...].astype(jnp.float32)
+    else:
+        wdy = dy
+    # grad_input (cuComputeGradInput): dx = inv*(wdy - mean(wdy) - xhat*mean(wdy*xhat))
+    m1 = wdy.mean(axis=1, keepdims=True)
+    m2 = (wdy * xhat).mean(axis=1, keepdims=True)
+    dx_ref[...] = (inv * (wdy - m1 - xhat * m2)).astype(dx_ref.dtype)
+    # γ/β partials accumulated across the sequential grid.
+    part_dw = (dy * xhat).sum(axis=0, keepdims=True)
+    part_db = dy.sum(axis=0, keepdims=True)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+        db_ref[...] = jnp.zeros_like(db_ref)
+
+    dw_ref[...] += part_dw
+    db_ref[...] += part_db
+
+
+def _pad_rows(x: jax.Array, rows: int) -> jax.Array:
+    pad = (-rows) % _BLOCK_ROWS
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "affine"))
+def _forward(x2d, w, b, eps: float, affine: bool):
+    n1, n2 = x2d.shape
+    xp = _pad_rows(x2d, n1)
+    rows = xp.shape[0]
+    grid = rows // _BLOCK_ROWS
+    w2 = (w if w is not None else jnp.ones((n2,), jnp.float32)).reshape(1, n2)
+    b2 = (b if b is not None else jnp.zeros((n2,), jnp.float32)).reshape(1, n2)
+    y, mean, inv = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps, affine=affine),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, n2), lambda i: (i, 0)),
+            pl.BlockSpec((1, n2), lambda i: (0, 0)),
+            pl.BlockSpec((1, n2), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, n2), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, n2), x2d.dtype),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+        interpret=not on_tpu(),
+    )(xp, w2, b2)
+    return y[:n1], mean[:n1], inv[:n1]
+
+
+@functools.partial(jax.jit, static_argnames=("affine",))
+def _backward(dy, x2d, w, mean, inv, affine: bool):
+    n1, n2 = x2d.shape
+    dyp = _pad_rows(dy, n1)
+    xp = _pad_rows(x2d, n1)
+    meanp = _pad_rows(mean, n1)
+    # Pad inv with ones (zeros are fine too: dy pad rows are zero so all
+    # partials vanish; ones avoid 0*inf style surprises).
+    invp = _pad_rows(inv, n1)
+    rows = xp.shape[0]
+    grid = rows // _BLOCK_ROWS
+    w2 = (w if w is not None else jnp.ones((n2,), jnp.float32)).reshape(1, n2)
+    dx, dw, db = pl.pallas_call(
+        functools.partial(_bwd_kernel, affine=affine),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, n2), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, n2), lambda i: (i, 0)),
+            pl.BlockSpec((1, n2), lambda i: (0, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, n2), lambda i: (i, 0)),
+            pl.BlockSpec((1, n2), lambda i: (0, 0)),
+            pl.BlockSpec((1, n2), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, n2), x2d.dtype),
+            jax.ShapeDtypeStruct((1, n2), jnp.float32),
+            jax.ShapeDtypeStruct((1, n2), jnp.float32),
+        ],
+        interpret=not on_tpu(),
+    )(dyp, xp, w2, meanp, invp)
+    return dx[:n1], dw.reshape(n2), db.reshape(n2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _ln_affine(x2d, w, b, eps):
+    y, _, _ = _forward(x2d, w, b, eps, affine=True)
+    return y
+
+
+def _ln_affine_fwd(x2d, w, b, eps):
+    y, mean, inv = _forward(x2d, w, b, eps, affine=True)
+    return y, (x2d, w, mean, inv)
+
+
+def _ln_affine_bwd(eps, res, dy):
+    x2d, w, mean, inv = res
+    dx, dw, db = _backward(dy, x2d, w, mean, inv, affine=True)
+    return dx, dw.astype(w.dtype), db.astype(w.dtype)
+
+
+_ln_affine.defvjp(_ln_affine_fwd, _ln_affine_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _ln_plain(x2d, eps):
+    y, _, _ = _forward(x2d, None, None, eps, affine=False)
+    return y
+
+
+def _ln_plain_fwd(x2d, eps):
+    y, mean, inv = _forward(x2d, None, None, eps, affine=False)
+    return y, (x2d, mean, inv)
+
+
+def _ln_plain_bwd(eps, res, dy):
+    x2d, mean, inv = res
+    dx, _, _ = _backward(dy, x2d, None, mean, inv, affine=False)
+    return (dx,)
+
+
+_ln_plain.defvjp(_ln_plain_fwd, _ln_plain_bwd)
+
+
+def layer_norm_fwd_vjp(x2d: jax.Array, w: Optional[jax.Array],
+                       b: Optional[jax.Array], eps: float) -> jax.Array:
+    """Differentiable fused layer norm on a (n1, n2) view."""
+    if w is not None:
+        return _ln_affine(x2d, w, b, eps)
+    return _ln_plain(x2d, eps)

@@ -1,0 +1,31 @@
+"""apex_tpu.optimizers — fused optimizers.
+
+Reference surface: ``apex/optimizers/__init__.py:1-2`` exports ``FusedAdam``
+and ``FP16_Optimizer``; this package adds ``FusedLAMB`` (the driver the
+reference snapshot ships kernels for but never wrote — SURVEY.md §0) and
+``LARC`` (which the reference keeps in ``apex.parallel``; re-exported there
+too).
+"""
+
+from apex_tpu.optimizers.fp16_optimizer import FlatFP16State, FP16Optimizer
+from apex_tpu.optimizers.fused_adam import (
+    EPS_MODE_INSIDE,
+    EPS_MODE_OUTSIDE,
+    FusedAdam,
+    FusedAdamState,
+    adam_step,
+    fused_adam,
+)
+from apex_tpu.optimizers.fused_lamb import FusedLAMB, FusedLAMBState, fused_lamb
+from apex_tpu.optimizers.larc import LARC, larc
+
+# Reference-spelling alias (apex.optimizers.FP16_Optimizer).
+FP16_Optimizer = FP16Optimizer
+
+__all__ = [
+    "FusedAdam", "fused_adam", "FusedAdamState", "adam_step",
+    "EPS_MODE_INSIDE", "EPS_MODE_OUTSIDE",
+    "FusedLAMB", "fused_lamb", "FusedLAMBState",
+    "FP16Optimizer", "FP16_Optimizer", "FlatFP16State",
+    "LARC", "larc",
+]

@@ -1,0 +1,113 @@
+"""FusedLAMB — layer-wise adaptive large-batch optimizer.
+
+The reference snapshot ships the two LAMB CUDA kernels but **no** Python
+driver (SURVEY.md §0: ``csrc/multi_tensor_lamb_stage_1.cu``,
+``multi_tensor_lamb_stage_2.cu`` are exported from ``amp_C`` yet
+``apex/optimizers/__init__.py:1-2`` never grew a ``FusedLAMB``).  This driver
+is authored from the kernel semantics:
+
+- **Stage 1** (``multi_tensor_lamb_stage_1.cu:17-121``): gradients divided by
+  the *clipped global norm* factor (global-norm clipping folded into the
+  pass) and by the loss scale; Adam moment update; per-tensor
+  ``update = m̂ / (sqrt(v̂) + eps) + weight_decay · p`` with bias correction
+  computed host-side.
+- **Stage 2** (``multi_tensor_lamb_stage_2.cu:18-92``): per-tensor trust
+  ratio ``‖p‖ / ‖update‖`` (falling back to 1 when either norm is zero, i.e.
+  the plain ``lr`` step), then ``p -= lr · ratio · update``.
+
+Per-tensor norms ride per-leaf fp32 reductions (see
+:func:`apex_tpu.ops.multi_tensor.multi_tensor_l2norm` per-tensor note).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class FusedLAMBState(NamedTuple):
+    step: jax.Array
+    m: Any
+    v: Any
+
+
+def fused_lamb(learning_rate=1e-3, beta1: float = 0.9, beta2: float = 0.999,
+               eps: float = 1e-6, weight_decay: float = 0.01,
+               max_grad_norm: float = 1.0, bias_correction: bool = True,
+               scale=1.0) -> optax.GradientTransformation:
+    """optax transformation with the two-stage LAMB semantics above.
+
+    ``max_grad_norm`` is the global-norm clip threshold of stage 1 (pass 0 to
+    disable); ``scale`` is the loss-scale divisor like FusedAdam's.
+    """
+
+    def init(params):
+        zeros = lambda t: jax.tree.map(
+            lambda x: jnp.zeros(x.shape, jnp.float32), t)
+        return FusedLAMBState(step=jnp.zeros((), jnp.int32),
+                              m=zeros(params), v=zeros(params))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_lamb requires params")
+        step = state.step + 1
+        lr = learning_rate(step) if callable(learning_rate) else learning_rate
+        lr = jnp.asarray(lr, jnp.float32)
+
+        gs, treedef = jax.tree.flatten(grads)
+        ps = treedef.flatten_up_to(params)
+        ms = treedef.flatten_up_to(state.m)
+        vs = treedef.flatten_up_to(state.v)
+
+        gs32 = [g.astype(jnp.float32) / jnp.asarray(scale, jnp.float32)
+                for g in gs]
+        # Stage-1 global-norm clip factor (lamb_stage_1.cu clipped_global_norm).
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in gs32))
+        if max_grad_norm and max_grad_norm > 0:
+            clip = jnp.maximum(gnorm / max_grad_norm, 1.0)
+        else:
+            clip = jnp.asarray(1.0, jnp.float32)
+
+        if bias_correction:
+            bc1 = 1.0 - jnp.power(beta1, step.astype(jnp.float32))
+            bc2 = 1.0 - jnp.power(beta2, step.astype(jnp.float32))
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+
+        updates, new_m, new_v = [], [], []
+        for p, m, v, g in zip(ps, ms, vs, gs32):
+            p32 = p.astype(jnp.float32)
+            g = g / clip
+            m = beta1 * m + (1.0 - beta1) * g
+            v = beta2 * v + (1.0 - beta2) * g * g
+            m_hat = m / bc1
+            v_hat = v / bc2
+            upd = m_hat / (jnp.sqrt(v_hat) + eps) + weight_decay * p32
+            # Stage 2: per-tensor trust ratio.
+            p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+            u_norm = jnp.sqrt(jnp.sum(jnp.square(upd)))
+            ratio = jnp.where((p_norm > 0) & (u_norm > 0),
+                              p_norm / jnp.maximum(u_norm, 1e-38), 1.0)
+            updates.append((-lr * ratio * upd).astype(p.dtype))
+            new_m.append(m)
+            new_v.append(v)
+
+        return (jax.tree.unflatten(treedef, updates),
+                FusedLAMBState(step=step,
+                               m=jax.tree.unflatten(treedef, new_m),
+                               v=jax.tree.unflatten(treedef, new_v)))
+
+    return optax.GradientTransformation(init, update)
+
+
+def FusedLAMB(lr=1e-3, bias_correction=True, betas=(0.9, 0.999), eps=1e-6,
+              weight_decay=0.01, max_grad_norm=1.0) -> optax.GradientTransformation:
+    """Constructor spelled like FusedAdam's (the driver the reference never
+    wrote; BASELINE config 4 requires it)."""
+    return fused_lamb(learning_rate=lr, beta1=betas[0], beta2=betas[1],
+                      eps=eps, weight_decay=weight_decay,
+                      max_grad_norm=max_grad_norm,
+                      bias_correction=bias_correction)

@@ -1,0 +1,72 @@
+"""LARC — Layer-wise Adaptive Rate Clipping.
+
+Port of ``apex/parallel/LARC.py:6-97``: an optimizer *wrapper* that rescales
+each parameter's gradient by an adaptive rate
+``trust_coefficient · ‖p‖ / (‖g‖ + weight_decay·‖p‖ + eps)`` before the inner
+optimizer runs.  ``clip=True`` caps the ratio at the inner learning rate
+(``min(adaptive_lr / lr, 1)``, ``LARC.py:82-86``); ``clip=False`` is pure
+scaling mode.  Weight decay is folded into the gradient and zeroed for the
+inner step (``LARC.py:88-97``).
+
+Expressed as an optax gradient transformation to be chained *before* the
+inner optimizer: ``optax.chain(larc(lr, ...), optax.sgd(lr))``, or use the
+:func:`LARC` convenience wrapper.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def larc(learning_rate, trust_coefficient: float = 0.02, clip: bool = True,
+         eps: float = 1e-8, weight_decay: float = 0.0
+         ) -> optax.GradientTransformation:
+    """The gradient-rescaling stage of LARC (``LARC.py:68-97``)."""
+
+    def init(params):
+        return optax.EmptyState()
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("larc requires params")
+        lr = learning_rate(0) if callable(learning_rate) else learning_rate
+        lr = jnp.asarray(lr, jnp.float32)
+
+        def leaf(g, p):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+            g_norm = jnp.sqrt(jnp.sum(jnp.square(g32)))
+            adaptive_lr = (trust_coefficient * p_norm
+                           / (g_norm + p_norm * weight_decay + eps))
+            if clip:
+                # Inner optimizer multiplies by lr, so cap the ratio at 1
+                # (LARC.py:82-86).
+                decayed = jnp.minimum(adaptive_lr / lr, 1.0)
+            else:
+                # Scaling mode: grad scaled by the raw adaptive rate; the
+                # inner lr multiplies on top (LARC.py:87).
+                decayed = adaptive_lr
+            scaled = (g32 + weight_decay * p32) * decayed
+            # Reference applies LARC only where both norms are nonzero,
+            # leaving the grad untouched otherwise (LARC.py:78-81).
+            out = jnp.where((p_norm > 0) & (g_norm > 0), scaled, g32)
+            return out.astype(g.dtype)
+
+        return jax.tree.map(leaf, grads, params), state
+
+    return optax.GradientTransformation(init, update)
+
+
+def LARC(optimizer: optax.GradientTransformation, learning_rate,
+         trust_coefficient: float = 0.02, clip: bool = True,
+         eps: float = 1e-8, weight_decay: float = 0.0
+         ) -> optax.GradientTransformation:
+    """Wrap an inner optimizer with LARC (reference constructor shape,
+    ``LARC.py:54-66``)."""
+    return optax.chain(
+        larc(learning_rate, trust_coefficient=trust_coefficient, clip=clip,
+             eps=eps, weight_decay=weight_decay),
+        optimizer)

@@ -1,0 +1,49 @@
+"""apex_tpu.parallel — data parallelism over TPU meshes.
+
+Reference surface (``apex/parallel/__init__.py``): ``DistributedDataParallel``,
+``Reducer``, ``SyncBatchNorm``, ``convert_syncbn_model``,
+``create_syncbn_process_group``, ``ReduceOp``, ``LARC``.
+"""
+
+from apex_tpu.optimizers.larc import LARC, larc
+from apex_tpu.parallel import mesh, multiproc
+from apex_tpu.parallel.distributed import (
+    DistributedDataParallel,
+    ReduceConfig,
+    ReduceOp,
+    Reducer,
+    all_gather,
+    all_reduce,
+    broadcast,
+    reduce_gradients,
+)
+from apex_tpu.parallel.groups import (
+    convert_syncbn_model,
+    create_syncbn_process_group,
+)
+from apex_tpu.parallel.mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    data_parallel_mesh,
+    make_mesh,
+    replicated_sharding,
+    world_size,
+)
+from apex_tpu.parallel.sync_batchnorm import (
+    BatchNorm,
+    SyncBatchNorm,
+    batchnorm_forward,
+    welford_mean_var,
+    welford_parallel,
+)
+
+__all__ = [
+    "DistributedDataParallel", "Reducer", "ReduceConfig", "ReduceOp",
+    "all_reduce", "all_gather", "broadcast", "reduce_gradients",
+    "SyncBatchNorm", "BatchNorm", "convert_syncbn_model",
+    "create_syncbn_process_group",
+    "welford_mean_var", "welford_parallel", "batchnorm_forward",
+    "LARC", "larc",
+    "mesh", "multiproc", "make_mesh", "data_parallel_mesh", "batch_sharding",
+    "replicated_sharding", "world_size", "DATA_AXIS",
+]

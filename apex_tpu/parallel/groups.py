@@ -1,0 +1,65 @@
+"""Process-group helpers for SyncBatchNorm sub-grouping.
+
+Port of ``apex/parallel/__init__.py:21-92`` (``convert_syncbn_model`` /
+``create_syncbn_process_group``).  On TPU a "process group" is an
+``axis_index_groups`` partition of a mesh axis — no communicator objects to
+construct, and unlike the reference there is no requirement that every rank
+execute the construction (it's just a list).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import flax.linen as nn
+
+
+def create_syncbn_process_group(group_size: int,
+                                world_size: int) -> Optional[List[List[int]]]:
+    """Partition ``world_size`` ranks into contiguous groups of
+    ``group_size`` for BN-stat reduction (``parallel/__init__.py:55-92``).
+
+    Returns ``axis_index_groups`` for ``lax.all_gather`` /
+    ``SyncBatchNorm(process_group=...)``, or None for group_size 0 (= whole
+    world, reference behavior).
+    """
+    if group_size == 0:
+        return None
+    if group_size > world_size:
+        raise ValueError(
+            f"group_size {group_size} exceeds world size {world_size}")
+    if world_size % group_size != 0:
+        raise ValueError(
+            f"world size {world_size} must be divisible by group_size "
+            f"{group_size} (reference asserts the same)")
+    return [list(range(g * group_size, (g + 1) * group_size))
+            for g in range(world_size // group_size)]
+
+
+#: Fields a model must expose (and thread to its BatchNorms) for
+#: convert_syncbn_model to work; apex_tpu.models follows this convention.
+SYNC_BN_FIELDS = ("bn_axis_name", "bn_process_group")
+
+
+def convert_syncbn_model(module: nn.Module, axis_name: str = "data",
+                         process_group: Optional[Sequence[Sequence[int]]] = None
+                         ) -> nn.Module:
+    """Return a copy of ``module`` with its BatchNorms synchronized
+    (reference ``convert_syncbn_model``, ``parallel/__init__.py:21-53``).
+
+    linen modules are immutable dataclasses, so instead of recursively
+    swapping submodule instances (the torch approach), the model declares
+    ``bn_axis_name`` / ``bn_process_group`` fields that it threads into its
+    :class:`~apex_tpu.parallel.SyncBatchNorm` layers; this returns
+    ``module.clone()`` with those fields set.  Because
+    ``SyncBatchNorm(axis_name=None)`` *is* the local BatchNorm, the param and
+    batch_stats pytrees are identical before and after conversion — running
+    stats and affine params carry over exactly as the reference requires.
+    """
+    missing = [f for f in SYNC_BN_FIELDS if not hasattr(module, f)]
+    if missing:
+        raise TypeError(
+            f"{type(module).__name__} does not declare {missing}; models "
+            "must thread bn_axis_name/bn_process_group into their BatchNorm "
+            "layers to be convertible (see apex_tpu.models.resnet).")
+    return module.clone(bn_axis_name=axis_name, bn_process_group=process_group)

@@ -1,0 +1,58 @@
+"""Device-mesh construction helpers.
+
+The reference's process-group runtime (``torch.distributed`` init, NCCL
+communicators) has no TPU analog — SPMD over a ``jax.sharding.Mesh`` replaces
+it (SURVEY.md §5.8).  These helpers build the meshes the rest of the package
+assumes:
+
+- a 1-D ``("data",)`` mesh is the apex-DDP world;
+- a 2-D ``("data", "model")`` mesh is available for pjit-style tensor
+  sharding beyond the reference's capabilities.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+
+
+def make_mesh(shape: Optional[Tuple[int, ...]] = None,
+              axis_names: Sequence[str] = (DATA_AXIS,),
+              devices=None) -> Mesh:
+    """Build a mesh over all (or the given) devices.
+
+    ``shape=None`` puts every device on the first axis.  Axis sizes must
+    multiply to the device count.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, axis_names=tuple(axis_names))
+
+
+def data_parallel_mesh(num_devices: Optional[int] = None) -> Mesh:
+    """The DDP-equivalent mesh: all devices on one ``"data"`` axis."""
+    devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return make_mesh(devices=devices)
+
+
+def batch_sharding(mesh: Mesh, axis_name: str = DATA_AXIS) -> NamedSharding:
+    """Sharding that splits the leading (batch) dim over ``axis_name``."""
+    return NamedSharding(mesh, PartitionSpec(axis_name))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated sharding (parameters under pure DP)."""
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def world_size(mesh: Mesh, axis_name: str = DATA_AXIS) -> int:
+    return mesh.shape[axis_name]

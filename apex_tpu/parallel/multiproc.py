@@ -1,0 +1,39 @@
+"""Multi-host launch helper.
+
+Port of ``apex/parallel/multiproc.py:1-35`` (the one-process-per-GPU
+spawner).  On TPU the launch model is one process per *host*, each seeing its
+local chips, coordinated by ``jax.distributed.initialize`` — there is nothing
+to spawn per chip.  This module provides the initialization wrapper plus the
+reference's env-var conventions.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Initialize multi-host JAX (the ``torch.distributed.launch`` /
+    ``multiproc.py`` analog).
+
+    Arguments default from the environment (``COORDINATOR_ADDRESS``,
+    ``WORLD_SIZE``, ``RANK`` — the reference's env contract,
+    ``_amp_state.py:38-40``); on Cloud TPU all three are auto-detected and
+    ``jax.distributed.initialize()`` needs no arguments.
+    """
+    kwargs = {}
+    addr = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if addr:
+        kwargs["coordinator_address"] = addr
+    ws = num_processes or os.environ.get("WORLD_SIZE")
+    if ws:
+        kwargs["num_processes"] = int(ws)
+    rank = process_id if process_id is not None else os.environ.get("RANK")
+    if rank is not None:
+        kwargs["process_id"] = int(rank)
+    jax.distributed.initialize(**kwargs)

@@ -1,0 +1,3 @@
+from apex_tpu.utils.logging import maybe_print, set_verbosity, warn_or_err
+
+__all__ = ["maybe_print", "set_verbosity", "warn_or_err"]

@@ -1,0 +1,48 @@
+"""Rank-0-aware, verbosity-gated logging.
+
+Port of the reference's ``apex/amp/_amp_state.py:31-52`` (``maybe_print`` /
+``master_print``): under multi-process SPMD only process 0 prints, and
+messages are gated on a global verbosity that ``amp.initialize`` sets.
+"""
+
+from __future__ import annotations
+
+import sys
+import warnings
+
+import jax
+
+_verbosity = 1
+
+
+def set_verbosity(v: int) -> None:
+    global _verbosity
+    _verbosity = int(v)
+
+
+def _is_rank0() -> bool:
+    try:
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+def maybe_print(message: str, rank0_only: bool = True, min_verbosity: int = 1,
+                file=None) -> None:
+    """Print gated on verbosity and (by default) process index
+    (reference ``_amp_state.py:43-52``)."""
+    if _verbosity < min_verbosity:
+        return
+    if rank0_only and not _is_rank0():
+        return
+    print(message, file=file or sys.stdout)
+
+
+def warn_or_err(condition: bool, message: str, strict: bool = False) -> None:
+    """Warn (or raise under strict mode) on a policy inconsistency
+    (reference ``_amp_state.py:54-62`` warn_or_err)."""
+    if condition:
+        return
+    if strict:
+        raise RuntimeError(message)
+    warnings.warn(message)

@@ -1,0 +1,22 @@
+"""Test config: force an 8-device CPU platform before jax initializes.
+
+This is the test strategy SURVEY.md §4.3 prescribes: every collective
+component gets a multi-device test runnable without TPU hardware via
+``--xla_force_host_platform_device_count`` (strictly better than the
+reference, which could only test distributed paths on a multi-GPU rig).
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (import after env setup)
+
+# The environment's sitecustomize pins jax_platforms to the TPU plugin;
+# override at the config level (env vars are ignored) so tests run on the
+# virtual 8-device CPU platform.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)

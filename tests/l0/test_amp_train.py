@@ -1,0 +1,117 @@
+"""End-to-end amp training tests — Milestone A of SURVEY.md §7.
+
+The MNIST-MLP O1 run must track an fp32 reference run within tolerance
+(BASELINE config 1), and the O0–O3 levels must produce the documented
+dtype/master-weight behavior.  This is the port of the reference's
+``test_multiple_models_optimizers_losses.py`` conformance axis, adapted to
+tolerance-based comparison per SURVEY.md §7 "Bitwise L1 conformance".
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.models.mlp import MLP, cross_entropy_loss
+
+
+def make_data(key, n=64, dim=28 * 28, classes=10):
+    kx, ky = jax.random.split(key)
+    x = jax.random.normal(kx, (n, dim), jnp.float32)
+    y = jax.random.randint(ky, (n,), 0, classes)
+    return x, y
+
+
+def train(opt_level, steps=20, lr=0.05, enabled=True, loss_scale=None):
+    model = MLP(features=(64, 64))
+    key = jax.random.PRNGKey(0)
+    params = model.init(key, jnp.zeros((1, 28 * 28)))["params"]
+
+    a = amp.initialize(
+        apply_fn=lambda p, x: model.apply({"params": p}, x),
+        optimizer=optax.sgd(lr),
+        opt_level=opt_level, enabled=enabled, loss_scale=loss_scale,
+        verbosity=0)
+    state = a.init(params)
+
+    step = jax.jit(amp.make_train_step(
+        a, lambda p, x, y: cross_entropy_loss(
+            model.apply({"params": p}, x), y)))
+
+    x, y = make_data(jax.random.PRNGKey(1))  # fixed batch: loss must drop
+    losses = []
+    for i in range(steps):
+        state, metrics = step(state, x, y)
+        losses.append(float(metrics["loss"]))
+    return np.array(losses), state, a
+
+
+def test_o1_matches_fp32_reference():
+    ref_losses, _, _ = train("O0")
+    o1_losses, _, _ = train("O1")
+    assert np.all(np.isfinite(o1_losses))
+    # bf16 compute tracks fp32 loss curve within a loose tolerance
+    np.testing.assert_allclose(o1_losses, ref_losses, rtol=0.1, atol=0.05)
+    # and training actually works
+    assert o1_losses[-1] < o1_losses[0] * 0.7
+
+
+def test_o2_masters_stay_fp32_and_track_reference():
+    ref_losses, _, _ = train("O0")
+    o2_losses, state, a = train("O2")
+    for leaf in jax.tree.leaves(state.master_params):
+        assert leaf.dtype == jnp.float32
+    compute = a.model_params(state)
+    leaves = jax.tree.leaves(compute)
+    assert any(l.dtype == jnp.bfloat16 for l in leaves)
+    np.testing.assert_allclose(o2_losses, ref_losses, rtol=0.1, atol=0.05)
+
+
+def test_o3_pure_half():
+    _, state, a = train("O3")
+    for leaf in jax.tree.leaves(a.model_params(state)):
+        assert leaf.dtype == jnp.bfloat16
+
+
+def test_disabled_passthrough():
+    d_losses, state, _ = train("O1", enabled=False)
+    ref_losses, _, _ = train("O0")
+    np.testing.assert_allclose(d_losses, ref_losses, rtol=1e-5, atol=1e-6)
+
+
+def test_overflow_skips_step_and_halves_scale():
+    model = MLP(features=(16,))
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4)))["params"]
+    a = amp.initialize(optimizer=optax.sgd(0.1), opt_level="O2", verbosity=0)
+    state = a.init(params)
+    before = jax.tree.leaves(state.master_params)[0]
+
+    # Inject inf grads (the reference's planted-inf tests,
+    # test_multiple_models_optimizers_losses.py:69-80).
+    grads = jax.tree.map(lambda p: jnp.full(p.shape, jnp.inf, jnp.bfloat16),
+                         a.model_params(state))
+    state, info = jax.jit(a.apply_gradients)(state, grads)
+    assert bool(info["overflow"])
+    assert float(info["loss_scale"]) == 2.0 ** 15
+    after = jax.tree.leaves(state.master_params)[0]
+    np.testing.assert_array_equal(np.asarray(before), np.asarray(after))
+
+
+def test_static_loss_scale_o2():
+    losses, _, _ = train("O2", loss_scale=128.0)
+    assert np.all(np.isfinite(losses))
+
+
+def test_multiple_losses_independent_scalers():
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    a = amp.initialize(optimizer=optax.sgd(0.1), opt_level="O2",
+                       num_losses=2, verbosity=0)
+    state = a.init(params)
+    good = {"w": jnp.ones((4,), jnp.bfloat16)}
+    bad = {"w": jnp.full((4,), jnp.inf, jnp.bfloat16)}
+    state, info0 = a.apply_gradients(state, bad, loss_id=0)
+    state, info1 = a.apply_gradients(state, good, loss_id=1)
+    assert float(state.scaler_states[0].loss_scale) == 2.0 ** 15
+    assert float(state.scaler_states[1].loss_scale) == 2.0 ** 16

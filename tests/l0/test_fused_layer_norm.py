@@ -1,0 +1,108 @@
+"""FusedLayerNorm tests.
+
+Port of ``tests/L0/run_fused_layer_norm/test_fused_layer_norm.py:9-41``
+(fused output vs reference path, affine and not) extended with gradient
+checks and pallas(interpret)-vs-jnp conformance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.normalization import (
+    FusedLayerNorm,
+    fused_layer_norm,
+    fused_layer_norm_affine,
+)
+
+
+def ref_layer_norm(x, w, b, nshape, eps=1e-5):
+    n2 = int(np.prod(nshape))
+    x32 = np.asarray(x, np.float32).reshape(-1, n2)
+    mean = x32.mean(1, keepdims=True)
+    var = x32.var(1, keepdims=True)
+    y = (x32 - mean) / np.sqrt(var + eps)
+    if w is not None:
+        y = y * np.asarray(w, np.float32).reshape(1, n2)
+    if b is not None:
+        y = y + np.asarray(b, np.float32).reshape(1, n2)
+    return y.reshape(x.shape)
+
+
+@pytest.mark.parametrize("mode", ["jnp", "pallas"])
+@pytest.mark.parametrize("affine", [False, True])
+@pytest.mark.parametrize("shape,nshape", [((16, 32, 256), (256,)),
+                                          ((8, 100), (100,)),
+                                          ((4, 2, 3, 128), (128,))])
+def test_forward_matches_reference(monkeypatch, mode, affine, shape, nshape):
+    monkeypatch.setenv("APEX_TPU_KERNELS", mode)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    w = jnp.asarray(rng.rand(*nshape).astype(np.float32)) if affine else None
+    b = jnp.asarray(rng.randn(*nshape).astype(np.float32)) if affine else None
+    y = fused_layer_norm_affine(x, w, b, nshape)
+    np.testing.assert_allclose(np.asarray(y), ref_layer_norm(x, w, b, nshape),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["jnp", "pallas"])
+def test_gradients_match_reference(monkeypatch, mode):
+    monkeypatch.setenv("APEX_TPU_KERNELS", mode)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(160, 256).astype(np.float32))
+    w = jnp.asarray(1.0 + 0.1 * rng.randn(256).astype(np.float32))
+    b = jnp.asarray(0.1 * rng.randn(256).astype(np.float32))
+
+    def fused_loss(x, w, b):
+        return jnp.sum(jnp.sin(fused_layer_norm_affine(x, w, b, (256,))))
+
+    def ref_loss(x, w, b):
+        x32 = x.astype(jnp.float32)
+        mean = x32.mean(-1, keepdims=True)
+        var = x32.var(-1, keepdims=True)
+        y = (x32 - mean) * jax.lax.rsqrt(var + 1e-5) * w + b
+        return jnp.sum(jnp.sin(y))
+
+    gf = jax.grad(fused_loss, argnums=(0, 1, 2))(x, w, b)
+    gr = jax.grad(ref_loss, argnums=(0, 1, 2))(x, w, b)
+    for a, c in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("mode", ["jnp", "pallas"])
+def test_bf16_input_fp32_stats(monkeypatch, mode):
+    monkeypatch.setenv("APEX_TPU_KERNELS", mode)
+    rng = np.random.RandomState(2)
+    # large offset: fp32 stats keep precision where bf16 stats would not.
+    # Reference runs on the SAME bf16-quantized input so only the stat/output
+    # precision is under test, not input rounding.
+    x = jnp.asarray((100.0 + rng.randn(64, 128)).astype(np.float32))
+    xbf = x.astype(jnp.bfloat16)
+    y_ref = fused_layer_norm(xbf.astype(jnp.float32), (128,))
+    ybf = fused_layer_norm(xbf, (128,))
+    assert ybf.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(ybf, np.float32),
+                               np.asarray(y_ref), atol=0.05)
+
+
+def test_module_api():
+    m = FusedLayerNorm(normalized_shape=64)
+    x = jnp.ones((4, 64))
+    variables = m.init(jax.random.PRNGKey(0), x)
+    assert variables["params"]["scale"].shape == (64,)
+    assert variables["params"]["bias"].shape == (64,)
+    y = m.apply(variables, x)
+    # ones input → zero centered → y == bias == 0
+    np.testing.assert_allclose(np.asarray(y), 0.0, atol=1e-5)
+
+    m2 = FusedLayerNorm(normalized_shape=64, elementwise_affine=False)
+    v2 = m2.init(jax.random.PRNGKey(0), x)
+    assert "params" not in v2 or not v2["params"]
+
+
+def test_rejects_bad_trailing_shape():
+    x = jnp.ones((4, 32))
+    with pytest.raises(AssertionError):
+        fused_layer_norm(x, (64,))

@@ -1,0 +1,141 @@
+"""Multi-tensor op fuzz tests.
+
+Port of the reference kernel-fuzz harness (``tests/L0/run_amp/
+test_multi_tensor_scale.py:36-126`` and siblings): cross-product of sizes
+straddling chunk boundaries × chunk sizes × list repetition × dtypes,
+asserting value correctness AND overflow-flag detection with nan/inf planted
+at the first/last element of the first/last tensor.  Additionally asserts
+pallas(interpret)-vs-jnp path equality — the ext-vs-no-ext conformance axis.
+"""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.multi_tensor import (
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_scale,
+)
+
+CHUNK = 2048 * 32
+SIZES = [1, 129, 33333, CHUNK - 1, CHUNK, CHUNK + 1]
+
+
+def make_list(sizes, dtype, seed=0):
+    rng = np.random.RandomState(seed)
+    return [jnp.asarray(rng.randn(s).astype(np.float32)).astype(dtype)
+            for s in sizes]
+
+
+@pytest.mark.parametrize("mode", ["jnp", "pallas"])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_scale_values(monkeypatch, mode, dtype):
+    monkeypatch.setenv("APEX_TPU_KERNELS", mode)
+    xs = make_list(SIZES, dtype)
+    outs, flag = multi_tensor_scale(CHUNK, [xs], 0.5)
+    assert int(flag) == 0
+    for x, o in zip(xs, outs):
+        assert o.dtype == dtype
+        np.testing.assert_allclose(
+            np.asarray(o, np.float32), np.asarray(x, np.float32) * 0.5,
+            rtol=1e-2 if dtype == jnp.bfloat16 else 1e-6)
+
+
+@pytest.mark.parametrize("chunk", [2048 * 32, 4096])
+@pytest.mark.parametrize("repeat", [1, 7])
+def test_scale_chunk_boundaries(monkeypatch, chunk, repeat):
+    monkeypatch.setenv("APEX_TPU_KERNELS", "pallas")
+    xs = make_list([chunk - 1, chunk, chunk + 1] * repeat, jnp.float32)
+    outs, flag = multi_tensor_scale(chunk, [xs], 2.0)
+    assert int(flag) == 0
+    for x, o in zip(xs, outs):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(x) * 2.0,
+                                   rtol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["jnp", "pallas"])
+@pytest.mark.parametrize("bad", [np.inf, -np.inf, np.nan])
+@pytest.mark.parametrize("t_idx,e_pos", [(0, 0), (0, -1), (-1, 0), (-1, -1)])
+def test_scale_overflow_flag(monkeypatch, mode, bad, t_idx, e_pos):
+    monkeypatch.setenv("APEX_TPU_KERNELS", mode)
+    xs = make_list([100, CHUNK + 3, 77], jnp.float32)
+    xs[t_idx] = xs[t_idx].at[e_pos].set(bad)
+    _, flag = multi_tensor_scale(CHUNK, [xs], 1.0)
+    assert int(flag) == 1
+
+
+def test_scale_out_dtype_conversion(monkeypatch):
+    monkeypatch.setenv("APEX_TPU_KERNELS", "pallas")
+    xs = make_list([513, 2049], jnp.bfloat16)
+    outs, _ = multi_tensor_scale(CHUNK, [xs], 1.0, out_dtype=jnp.float32)
+    for x, o in zip(xs, outs):
+        assert o.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(o),
+                                   np.asarray(x, np.float32), rtol=1e-2)
+
+
+@pytest.mark.parametrize("mode", ["jnp", "pallas"])
+@pytest.mark.parametrize("arg_to_check", [-1, 0, 1])
+def test_axpby(monkeypatch, mode, arg_to_check):
+    monkeypatch.setenv("APEX_TPU_KERNELS", mode)
+    xs = make_list([100, 4097], jnp.float32, seed=1)
+    ys = make_list([100, 4097], jnp.float32, seed=2)
+    outs, flag = multi_tensor_axpby(CHUNK, [xs, ys], 2.0, 3.0,
+                                    arg_to_check=arg_to_check)
+    assert int(flag) == 0
+    for x, y, o in zip(xs, ys, outs):
+        np.testing.assert_allclose(np.asarray(o),
+                                   2.0 * np.asarray(x) + 3.0 * np.asarray(y),
+                                   rtol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["jnp", "pallas"])
+def test_axpby_arg_to_check_policy(monkeypatch, mode):
+    monkeypatch.setenv("APEX_TPU_KERNELS", mode)
+    xs = make_list([257], jnp.float32, seed=1)
+    ys = make_list([257], jnp.float32, seed=2)
+    ys[0] = ys[0].at[5].set(np.inf)
+    # checking only x: stale inf in y must NOT trip (scaler.py:167-172)
+    _, flag = multi_tensor_axpby(CHUNK, [xs, ys], 1.0, 1.0, arg_to_check=0)
+    assert int(flag) == 0
+    _, flag = multi_tensor_axpby(CHUNK, [xs, ys], 1.0, 1.0, arg_to_check=1)
+    assert int(flag) == 1
+    _, flag = multi_tensor_axpby(CHUNK, [xs, ys], 1.0, 1.0, arg_to_check=-1)
+    assert int(flag) == 1
+
+
+def test_axpby_fp32_accumulator_precision(monkeypatch):
+    """bf16 new grads into an fp32 accumulator must not round the
+    accumulator (the review-flagged regression)."""
+    monkeypatch.setenv("APEX_TPU_KERNELS", "pallas")
+    xs = [jnp.full((256,), 1.0, jnp.bfloat16)]
+    ys = [jnp.full((256,), 1000.0, jnp.float32) + 0.25]
+    outs, _ = multi_tensor_axpby(CHUNK, [xs, ys], 1.0, 1.0, arg_to_check=0,
+                                 out_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(outs[0]), 1001.25, rtol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["jnp", "pallas"])
+def test_l2norm(monkeypatch, mode):
+    monkeypatch.setenv("APEX_TPU_KERNELS", mode)
+    xs = make_list([100, CHUNK + 1, 333], jnp.float32)
+    total, per = multi_tensor_l2norm(CHUNK, [xs], per_tensor=True)
+    ref_per = np.array([np.linalg.norm(np.asarray(x)) for x in xs])
+    ref_total = np.sqrt((ref_per ** 2).sum())
+    np.testing.assert_allclose(float(total), ref_total, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(per), ref_per, rtol=1e-5)
+
+
+def test_mixed_dtype_list_groups(monkeypatch):
+    monkeypatch.setenv("APEX_TPU_KERNELS", "pallas")
+    xs = [jnp.ones((10,), jnp.float32), jnp.ones((20,), jnp.bfloat16),
+          jnp.ones((30,), jnp.float32)]
+    outs, flag = multi_tensor_scale(CHUNK, [xs], 3.0)
+    assert int(flag) == 0
+    assert [o.dtype for o in outs] == [jnp.float32, jnp.bfloat16, jnp.float32]
+    for x, o in zip(xs, outs):
+        np.testing.assert_allclose(np.asarray(o, np.float32), 3.0, rtol=1e-2)

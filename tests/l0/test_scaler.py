@@ -1,0 +1,115 @@
+"""Loss-scaler state machine tests.
+
+Conformance to reference ``apex/amp/scaler.py`` semantics: init 2**16,
+halve on overflow, double after scale_window clean steps, min/max clamps,
+static scale never moves but still skips.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.amp.scaler import LossScaler, all_finite
+
+
+def test_dynamic_defaults():
+    s = LossScaler()
+    st = s.init_state()
+    assert float(st.loss_scale) == 2.0 ** 16
+    assert int(st.unskipped) == 0
+
+
+def test_overflow_halves_and_resets():
+    s = LossScaler()
+    st = s.init_state()
+    st, skip = s.update(st, jnp.asarray(False))  # overflow
+    assert bool(skip)
+    assert float(st.loss_scale) == 2.0 ** 15
+    assert int(st.unskipped) == 0
+
+
+def test_window_doubles():
+    s = LossScaler(scale_window=3)
+    st = s.init_state()
+    for i in range(3):
+        st, skip = s.update(st, jnp.asarray(True))
+        assert not bool(skip)
+    assert float(st.loss_scale) == 2.0 ** 17
+    assert int(st.unskipped) == 0
+
+
+def test_max_scale_cap():
+    s = LossScaler(scale_window=1, max_loss_scale=2.0 ** 17)
+    st = s.init_state()
+    for _ in range(5):
+        st, _ = s.update(st, jnp.asarray(True))
+    assert float(st.loss_scale) == 2.0 ** 17
+
+
+def test_min_scale_floor():
+    s = LossScaler(min_loss_scale=2.0 ** 15)
+    st = s.init_state()
+    for _ in range(5):
+        st, _ = s.update(st, jnp.asarray(False))
+    assert float(st.loss_scale) == 2.0 ** 15
+
+
+def test_static_scale_never_moves_but_skips():
+    s = LossScaler(loss_scale=128.0)
+    st = s.init_state()
+    assert float(st.loss_scale) == 128.0
+    st, skip = s.update(st, jnp.asarray(False))
+    assert bool(skip)
+    assert float(st.loss_scale) == 128.0
+    st, skip = s.update(st, jnp.asarray(True))
+    assert not bool(skip)
+    assert float(st.loss_scale) == 128.0
+
+
+def test_unscale_and_finite_flag():
+    s = LossScaler(loss_scale=4.0)
+    st = s.init_state()
+    grads = {"a": jnp.asarray([4.0, 8.0], jnp.bfloat16),
+             "b": jnp.asarray([[2.0]], jnp.bfloat16)}
+    out, finite = s.unscale(grads, st)
+    assert bool(finite)
+    assert out["a"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out["a"]), [1.0, 2.0])
+    np.testing.assert_allclose(np.asarray(out["b"]), [[0.5]])
+
+    grads["a"] = grads["a"].at[1].set(jnp.inf)
+    _, finite = s.unscale(grads, st)
+    assert not bool(finite)
+
+
+def test_unscale_with_stashed_checks_only_new():
+    s = LossScaler(loss_scale=2.0)
+    st = s.init_state()
+    new = {"a": jnp.asarray([2.0, 4.0])}
+    stashed = {"a": jnp.asarray([jnp.inf, 1.0])}  # stale inf must NOT trip
+    out, finite = s.unscale_with_stashed(new, stashed, st)
+    assert bool(finite)
+    assert not np.isfinite(np.asarray(out["a"])[0])  # but result keeps it
+    np.testing.assert_allclose(np.asarray(out["a"])[1], 3.0)
+
+
+def test_all_finite_on_mixed_tree():
+    tree = {"x": jnp.ones((3,)), "n": jnp.asarray([1, 2]),  # ints ignored
+            "y": (jnp.zeros((2, 2)),)}
+    assert bool(all_finite(tree))
+    tree["y"] = (jnp.asarray([[1.0, jnp.nan], [0.0, 0.0]]),)
+    assert not bool(all_finite(tree))
+
+
+def test_update_inside_jit():
+    s = LossScaler()
+
+    @jax.jit
+    def step(st, ok):
+        return s.update(st, ok)
+
+    st = s.init_state()
+    st, skip = step(st, jnp.asarray(False))
+    assert bool(skip)
+    assert float(st.loss_scale) == 2.0 ** 15
