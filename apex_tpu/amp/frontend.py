@@ -297,16 +297,27 @@ def make_train_step(
     unscale, scaler update, and the conditional optimizer step in one
     compiled graph (the whole of reference §3.2's hot loop).
 
-    ``axis_name`` applies a mean-``psum`` to the scaled grads (plain DP);
-    for the full knob set (predivide, fp32 wire, compression) pass
-    ``reduce_fn`` built by :func:`apex_tpu.parallel.ddp_reduce`.
+    ``axis_name`` marks the compute params device-varying (so grads
+    materialize per-rank, exactly like the reference's backward hooks) and
+    applies a mean-``psum`` (plain DP); for the full knob set (predivide,
+    fp32 wire, compression) also pass ``reduce_fn`` from
+    ``DistributedDataParallel(...).reduce``.  When running under shard_map
+    with a ``reduce_fn``, ``axis_name`` must be given — without it, SPMD
+    autodiff auto-sums grads of replicated params and an explicit reduce
+    would double-count.
     """
+    if axis_name is None and reduce_fn is not None:
+        axis_name = getattr(reduce_fn, "__self__", None) and \
+            getattr(reduce_fn.__self__, "axis_name", None)
     if axis_name is not None and reduce_fn is None:
         def reduce_fn(grads):
             return jax.lax.pmean(grads, axis_name)
 
     def step(state: AmpState, *batch):
+        from apex_tpu.parallel.distributed import pvary_params
         params_c = amp.model_params(state)
+        if axis_name is not None:
+            params_c = pvary_params(params_c, axis_name)
 
         def scaled_loss(p):
             out = amp.run(loss_fn, p, *batch)

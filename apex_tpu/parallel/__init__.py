@@ -15,6 +15,7 @@ from apex_tpu.parallel.distributed import (
     all_gather,
     all_reduce,
     broadcast,
+    pvary_params,
     reduce_gradients,
 )
 from apex_tpu.parallel.groups import (
@@ -40,6 +41,7 @@ from apex_tpu.parallel.sync_batchnorm import (
 __all__ = [
     "DistributedDataParallel", "Reducer", "ReduceConfig", "ReduceOp",
     "all_reduce", "all_gather", "broadcast", "reduce_gradients",
+    "pvary_params",
     "SyncBatchNorm", "BatchNorm", "convert_syncbn_model",
     "create_syncbn_process_group",
     "welford_mean_var", "welford_parallel", "batchnorm_forward",

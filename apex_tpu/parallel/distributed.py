@@ -84,10 +84,30 @@ class ReduceConfig:
     compression: Optional[str] = None  # None | "sign"
 
 
+def pvary_params(params: Any, axis_name: str) -> Any:
+    """Mark replicated params as device-varying so gradients materialize
+    *per-rank* instead of being auto-``psum``'d by shard_map's autodiff.
+
+    Under modern SPMD autodiff, the cotangent of a replicated value is summed
+    across the mesh automatically (the transpose of broadcast).  That is
+    correct but leaves no per-rank gradient to apply apex's wire-format knobs
+    (predivide, fp32 upcast, sign compression) to.  Calling this on the
+    params before ``jax.grad`` restores the reference's model: per-rank grads
+    (``allreduce_hook`` inputs) that the caller then reduces explicitly with
+    :func:`reduce_gradients`.  No data movement — it only tags the values.
+    """
+    return jax.tree.map(lambda p: lax.pvary(p, (axis_name,)), params)
+
+
 def reduce_gradients(grads: Any, axis_name: str,
                      config: ReduceConfig = ReduceConfig()) -> Any:
-    """Flat-semantics allreduce of a grad pytree
-    (``allreduce_bucket``, ``distributed.py:379-398``)."""
+    """Flat-semantics allreduce of a *per-rank* grad pytree
+    (``allreduce_bucket``, ``distributed.py:379-398``).
+
+    Expects unreduced (device-varying) grads — i.e. grads of params passed
+    through :func:`pvary_params`; reducing already-summed grads would
+    multiply them by the world size.
+    """
     world = lax.axis_size(axis_name)
 
     def reduce_leaf(g):
@@ -133,6 +153,11 @@ class DistributedDataParallel:
     @property
     def reduce_fn(self) -> Callable[[Any], Any]:
         return self.reduce
+
+    def pvary(self, params: Any) -> Any:
+        """See :func:`pvary_params` — apply to params before ``jax.grad`` so
+        grads arrive per-rank for :meth:`reduce`."""
+        return pvary_params(params, self.axis_name)
 
     def broadcast_params(self, params: Any, root: int = 0) -> Any:
         """Initial param sync (``distributed.py:242``)."""

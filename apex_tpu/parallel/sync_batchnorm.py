@@ -143,14 +143,33 @@ class SyncBatchNorm(nn.Module):
 
         local_mean, local_var, local_count = welford_mean_var(x, reduce_axes)
 
-        if self.axis_name is not None:
-            counts = jnp.full((1,), local_count, jnp.float32)
+        if self.axis_name is not None and self.process_group is None:
+            # Whole-axis sync: Chan's merge expressed as two psum rounds —
+            # the same math as gathering per-rank stats and merging
+            # (welford.cu:557-585), but psum outputs are replication-typed,
+            # which shard_map's VMA checker can verify, so running stats stay
+            # provably replicated.
+            c = lax.pvary(jnp.asarray(float(local_count), jnp.float32),
+                          (self.axis_name,))
+            total_count = lax.psum(c, self.axis_name)
+            mean = lax.psum(local_mean * c, self.axis_name) / total_count
+            m2 = lax.psum(c * local_var + c * jnp.square(local_mean - mean),
+                          self.axis_name)
+            var = m2 / total_count
+        elif self.axis_name is not None:
+            # Grouped sync: grouped psum is unsupported under VMA checking,
+            # so use the reference's own recipe — all_gather per-group stats
+            # then Chan-merge locally (optimized_sync_batchnorm_kernel.py:
+            # 33-39).  Results (and running stats) genuinely differ across
+            # groups, i.e. they are device-varying by construction.
+            groups = self.process_group
+            counts = jnp.full((1,), float(local_count), jnp.float32)
             g_mean = lax.all_gather(local_mean, self.axis_name,
-                                    axis_index_groups=self.process_group)
+                                    axis_index_groups=groups)
             g_var = lax.all_gather(local_var, self.axis_name,
-                                   axis_index_groups=self.process_group)
+                                   axis_index_groups=groups)
             g_count = lax.all_gather(counts, self.axis_name,
-                                     axis_index_groups=self.process_group)
+                                     axis_index_groups=groups)
             mean, var = welford_parallel(g_mean, g_var, g_count)
             total_count = g_count.sum()
         else:
