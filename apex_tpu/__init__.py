@@ -20,6 +20,8 @@ This package provides the capabilities of NVIDIA Apex (reference:
   (reference ``apex/multi_tensor_apply`` + ``csrc/multi_tensor_*``).
 - :mod:`apex_tpu.fp16_utils` — model/dtype conversion helpers, master-param
   utilities, and legacy loss scalers (reference ``apex/fp16_utils``).
+- :mod:`apex_tpu.rnn` — scanned-cell RNN stack: LSTM/GRU/ReLU/Tanh/mLSTM,
+  stacked, bidirectional, recurrent projections (reference ``apex/RNN``).
 
 Unlike the reference, which monkey-patches eager PyTorch, everything here is
 functional and jit-compiled: loss-scale state is a pytree carried through the
@@ -34,6 +36,7 @@ from apex_tpu import multi_tensor_apply
 from apex_tpu import normalization
 from apex_tpu import optimizers
 from apex_tpu import parallel
+from apex_tpu import rnn
 
 __version__ = "0.1.0"
 
@@ -44,5 +47,6 @@ __all__ = [
     "normalization",
     "optimizers",
     "parallel",
+    "rnn",
     "__version__",
 ]

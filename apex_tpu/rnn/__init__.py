@@ -1,0 +1,19 @@
+"""apex_tpu.rnn — scanned-cell RNN stack (reference ``apex/RNN``).
+
+Exports the factory functions the reference's ``apex/RNN/__init__.py``
+provides (LSTM/GRU/ReLU/Tanh/mLSTM) plus the module/cell building blocks.
+"""
+
+from apex_tpu.rnn.cells import (
+    CELLS,
+    GATE_MULTIPLIERS,
+    LSTMState,
+    init_state,
+    is_lstm_like,
+)
+from apex_tpu.rnn.models import GRU, LSTM, RNN, ReLU, RNNLayer, Tanh, mLSTM
+
+__all__ = [
+    "RNN", "RNNLayer", "LSTM", "GRU", "ReLU", "Tanh", "mLSTM",
+    "CELLS", "GATE_MULTIPLIERS", "LSTMState", "init_state", "is_lstm_like",
+]

@@ -1,0 +1,99 @@
+"""RNN cell math.
+
+Port of ``apex/RNN/cells.py`` + the cell semantics ``apex/RNN/RNNBackend.py``
+reuses from torch (``models.py:7-54``).  Each cell is a pure function
+``cell(params, x_t, state) -> (new_state, output)``; the matmuls route
+through :mod:`apex_tpu.amp.ops` so O1 policies govern them exactly as the
+reference's cuDNN-cast interposition did (``wrap.py:157-265``) — without any
+flat-weight aliasing, which has no TPU analog (SURVEY.md §7).
+
+Gate layouts follow torch conventions so the ``gate_multiplier`` bookkeeping
+of ``RNNBackend.RNNCell`` (``:232-365``) carries over: 1 for ReLU/Tanh,
+3 for GRU (r, z, n), 4 for LSTM/mLSTM (i, f, g, o).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import ops as amp_ops
+
+GATE_MULTIPLIERS = {"relu": 1, "tanh": 1, "gru": 3, "lstm": 4, "mlstm": 4}
+
+
+class LSTMState(NamedTuple):
+    h: jax.Array
+    c: jax.Array
+
+
+def _linear(x, w, b=None):
+    return amp_ops.linear(x, w, b)
+
+
+def relu_cell(params, x, h):
+    nh = jax.nn.relu(_linear(x, params["w_ih"], params.get("b_ih"))
+                     + _linear(h, params["w_hh"], params.get("b_hh")))
+    return nh, nh
+
+
+def tanh_cell(params, x, h):
+    nh = jnp.tanh(_linear(x, params["w_ih"], params.get("b_ih"))
+                  + _linear(h, params["w_hh"], params.get("b_hh")))
+    return nh, nh
+
+
+def lstm_cell(params, x, state: LSTMState):
+    gates = (_linear(x, params["w_ih"], params.get("b_ih"))
+             + _linear(state.h, params["w_hh"], params.get("b_hh")))
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c = f * state.c.astype(g.dtype) + i * g
+    h = o * jnp.tanh(c)
+    return LSTMState(h=h, c=c), h
+
+
+def mlstm_cell(params, x, state: LSTMState):
+    """Multiplicative LSTM (``cells.py:12-84``): an intermediate
+    ``m = (x·W_mi) ⊙ (h·W_mh)`` replaces h in the gate computation."""
+    m = _linear(x, params["w_mi"]) * _linear(state.h, params["w_mh"])
+    gates = (_linear(x, params["w_ih"], params.get("b_ih"))
+             + _linear(m, params["w_hh"], params.get("b_hh")))
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c = f * state.c.astype(g.dtype) + i * g
+    h = o * jnp.tanh(c)
+    return LSTMState(h=h, c=c), h
+
+
+def gru_cell(params, x, h):
+    """torch-semantics GRU: n-gate uses r ⊙ (W_hn·h)."""
+    gi = _linear(x, params["w_ih"], params.get("b_ih"))
+    gh = _linear(h, params["w_hh"], params.get("b_hh"))
+    i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+    h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(i_r + h_r)
+    z = jax.nn.sigmoid(i_z + h_z)
+    n = jnp.tanh(i_n + r * h_n)
+    nh = (1.0 - z) * n + z * h.astype(n.dtype)
+    return nh, nh
+
+
+CELLS = {"relu": relu_cell, "tanh": tanh_cell, "gru": gru_cell,
+         "lstm": lstm_cell, "mlstm": mlstm_cell}
+
+
+def is_lstm_like(mode: str) -> bool:
+    return mode in ("lstm", "mlstm")
+
+
+def init_state(mode: str, batch: int, hidden: int, dtype=jnp.float32):
+    """Zero hidden-state auto-init (``RNNBackend.py:286-309``)."""
+    h = jnp.zeros((batch, hidden), dtype)
+    if is_lstm_like(mode):
+        return LSTMState(h=h, c=jnp.zeros((batch, hidden), dtype))
+    return h

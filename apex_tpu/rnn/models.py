@@ -1,0 +1,165 @@
+"""Stacked / bidirectional RNN modules.
+
+Port of ``apex/RNN/RNNBackend.py`` (``stackedRNN`` ``:90-230``,
+``bidirectionalRNN`` ``:25-85``, ``RNNCell`` ``:232-365``) and the factory
+functions of ``apex/RNN/models.py:7-54``.  The reference's explicit
+per-timestep Python loop becomes ``jax.lax.scan`` — one compiled step reused
+across time, the TPU-idiomatic recurrence (no unrolled graph, no cuDNN flat
+weight buffer).
+
+Layout: inputs are (time, batch, features), matching the reference.
+Recurrent output projection (``output_size`` → ``w_ho``,
+``RNNBackend.py:253-262``) projects h before it re-enters the recurrence.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.rnn import cells as C
+
+
+class RNNLayer(nn.Module):
+    """One direction of one layer, scanned over time."""
+
+    mode: str
+    hidden_size: int
+    output_size: Optional[int] = None   # recurrent projection (w_ho)
+    bias: bool = True
+    reverse: bool = False
+    param_dtype: Any = jnp.float32
+
+    def _params(self, input_size: int):
+        gm = C.GATE_MULTIPLIERS[self.mode]
+        k = nn.initializers.uniform(scale=1.0 / jnp.sqrt(self.hidden_size))
+        hidden_in = self.output_size or self.hidden_size
+        p = {
+            "w_ih": self.param("w_ih", k, (input_size, gm * self.hidden_size),
+                               self.param_dtype),
+            "w_hh": self.param("w_hh", k, (hidden_in, gm * self.hidden_size),
+                               self.param_dtype),
+        }
+        if self.bias:
+            p["b_ih"] = self.param("b_ih", nn.initializers.zeros,
+                                   (gm * self.hidden_size,), self.param_dtype)
+            p["b_hh"] = self.param("b_hh", nn.initializers.zeros,
+                                   (gm * self.hidden_size,), self.param_dtype)
+        if self.mode == "mlstm":
+            p["w_mi"] = self.param("w_mi", k, (input_size, self.hidden_size),
+                                   self.param_dtype)
+            p["w_mh"] = self.param("w_mh", k, (hidden_in, self.hidden_size),
+                                   self.param_dtype)
+        if self.output_size is not None:
+            p["w_ho"] = self.param("w_ho", k,
+                                   (self.hidden_size, self.output_size),
+                                   self.param_dtype)
+        return p
+
+    @nn.compact
+    def __call__(self, xs: jax.Array, init_state=None):
+        from apex_tpu.amp import ops as amp_ops
+        # Under an active O1 policy the whole recurrence runs at the half
+        # dtype (the rnn_cast capability, wrap.py:157-265): cast inputs and
+        # carry up front so the scan carry dtype is stable.
+        policy = amp_ops.active_policy()
+        if policy is not None:
+            xs = xs.astype(policy.half_dtype)
+            if init_state is not None:
+                init_state = jax.tree.map(
+                    lambda t: t.astype(policy.half_dtype), init_state)
+        params = self._params(xs.shape[-1])
+        batch = xs.shape[1]
+        out_size = self.output_size or self.hidden_size
+        if init_state is None:
+            # h carries the (possibly projected) output size; c always the
+            # raw hidden size (RNNBackend.py:253-262).
+            if C.is_lstm_like(self.mode):
+                init_state = C.LSTMState(
+                    h=jnp.zeros((batch, out_size), xs.dtype),
+                    c=jnp.zeros((batch, self.hidden_size), xs.dtype))
+            else:
+                init_state = jnp.zeros((batch, out_size), xs.dtype)
+        cell = C.CELLS[self.mode]
+
+        def step(state, x_t):
+            new_state, out = cell(params, x_t, state)
+            if self.output_size is not None:
+                # project h before it re-enters the recurrence
+                # (RNNBackend.py:253-262)
+                out = jnp.matmul(out, params["w_ho"])
+                if C.is_lstm_like(self.mode):
+                    new_state = C.LSTMState(h=out, c=new_state.c)
+                else:
+                    new_state = out
+            return new_state, out
+
+        final, ys = jax.lax.scan(step, init_state, xs, reverse=self.reverse)
+        return ys, final
+
+
+class RNN(nn.Module):
+    """Stacked (optionally bidirectional) RNN
+    (``stackedRNN``/``bidirectionalRNN``).
+
+    Returns ``(outputs, final_states)``: outputs (T, B, H·dirs); final_states
+    a list per layer (tuples of per-direction states when bidirectional).
+    """
+
+    mode: str
+    hidden_size: int
+    num_layers: int = 1
+    bias: bool = True
+    bidirectional: bool = False
+    output_size: Optional[int] = None
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, xs: jax.Array, init_states=None):
+        finals = []
+        h = xs
+        for layer in range(self.num_layers):
+            init = None if init_states is None else init_states[layer]
+            fwd = RNNLayer(mode=self.mode, hidden_size=self.hidden_size,
+                           output_size=self.output_size, bias=self.bias,
+                           param_dtype=self.param_dtype,
+                           name=f"layer_{layer}_fwd")
+            if self.bidirectional:
+                bwd = RNNLayer(mode=self.mode, hidden_size=self.hidden_size,
+                               output_size=self.output_size, bias=self.bias,
+                               reverse=True, param_dtype=self.param_dtype,
+                               name=f"layer_{layer}_bwd")
+                init_f, init_b = (None, None) if init is None else init
+                ys_f, fin_f = fwd(h, init_f)
+                ys_b, fin_b = bwd(h, init_b)
+                h = jnp.concatenate([ys_f, ys_b], axis=-1)
+                finals.append((fin_f, fin_b))
+            else:
+                h, fin = fwd(h, init)
+                finals.append(fin)
+        return h, finals
+
+
+# -- factory functions (models.py:7-54) -------------------------------------
+
+def LSTM(hidden_size: int, **kw) -> RNN:
+    return RNN(mode="lstm", hidden_size=hidden_size, **kw)
+
+
+def GRU(hidden_size: int, **kw) -> RNN:
+    return RNN(mode="gru", hidden_size=hidden_size, **kw)
+
+
+def ReLU(hidden_size: int, **kw) -> RNN:
+    return RNN(mode="relu", hidden_size=hidden_size, **kw)
+
+
+def Tanh(hidden_size: int, **kw) -> RNN:
+    return RNN(mode="tanh", hidden_size=hidden_size, **kw)
+
+
+def mLSTM(hidden_size: int, **kw) -> RNN:
+    return RNN(mode="mlstm", hidden_size=hidden_size, **kw)

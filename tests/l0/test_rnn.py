@@ -1,0 +1,118 @@
+"""RNN stack tests.
+
+Port of ``tests/L0/run_amp/test_rnn.py:10-116`` adapted to the scanned-cell
+implementation: every cell type forward+backward, stacked and bidirectional
+shapes, hidden-state dtype under O1, projection, and an LSTM-vs-flax
+reference check.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import flax.linen as fnn
+
+from apex_tpu import amp
+from apex_tpu import rnn as apex_rnn
+
+T, B, F, H = 5, 3, 4, 8
+
+
+def data(seed=0):
+    return jnp.asarray(np.random.RandomState(seed).randn(T, B, F)
+                       .astype(np.float32))
+
+
+@pytest.mark.parametrize("mode", ["relu", "tanh", "gru", "lstm", "mlstm"])
+def test_forward_backward(mode):
+    model = apex_rnn.RNN(mode=mode, hidden_size=H)
+    x = data()
+    params = model.init(jax.random.PRNGKey(0), x)
+    (ys, finals), grads = jax.value_and_grad(
+        lambda p: (lambda o: jnp.sum(o[0] ** 2))(model.apply(p, x)),
+        has_aux=False)(params), None
+    ys_out, _ = model.apply(params, x)
+    assert ys_out.shape == (T, B, H)
+    g = jax.grad(lambda p: jnp.sum(model.apply(p, x)[0] ** 2))(params)
+    assert all(bool(jnp.isfinite(l).all()) for l in jax.tree.leaves(g))
+    assert all(float(jnp.abs(l).max()) > 0 for l in jax.tree.leaves(g)
+               if l.ndim == 2)
+
+
+def test_stacked_bidirectional_shapes():
+    model = apex_rnn.LSTM(hidden_size=H, num_layers=3, bidirectional=True)
+    x = data()
+    params = model.init(jax.random.PRNGKey(0), x)
+    ys, finals = model.apply(params, x)
+    assert ys.shape == (T, B, 2 * H)
+    assert len(finals) == 3
+    fin_f, fin_b = finals[0]
+    assert fin_f.h.shape == (B, H) and fin_b.c.shape == (B, H)
+
+
+def test_recurrent_projection():
+    model = apex_rnn.LSTM(hidden_size=H, output_size=6)
+    x = data()
+    params = model.init(jax.random.PRNGKey(0), x)
+    ys, finals = model.apply(params, x)
+    assert ys.shape == (T, B, 6)
+    assert finals[0].h.shape == (B, 6)   # projected h re-enters recurrence
+    assert finals[0].c.shape == (B, H)
+
+
+def test_lstm_matches_flax_reference():
+    """Same weights → same outputs as flax's LSTMCell (gate order i,f,g,o)."""
+    model = apex_rnn.LSTM(hidden_size=H)
+    x = data(1)
+    params = model.init(jax.random.PRNGKey(0), x)
+    p = params["params"]["layer_0_fwd"]
+
+    cell = fnn.OptimizedLSTMCell(features=H)
+    # flax LSTMCell params: ii/if/ig/io (kernel from input), hi/hf/hg/ho
+    w_ih = np.asarray(p["w_ih"])  # (F, 4H) order i,f,g,o
+    w_hh = np.asarray(p["w_hh"])
+    b = np.asarray(p["b_ih"]) + np.asarray(p["b_hh"])
+    carry = (jnp.zeros((B, H)), jnp.zeros((B, H)))
+    flax_params = {"params": {
+        "ii": {"kernel": w_ih[:, 0:H]}, "if": {"kernel": w_ih[:, H:2*H]},
+        "ig": {"kernel": w_ih[:, 2*H:3*H]}, "io": {"kernel": w_ih[:, 3*H:]},
+        "hi": {"kernel": w_hh[:, 0:H], "bias": b[0:H]},
+        "hf": {"kernel": w_hh[:, H:2*H], "bias": b[H:2*H]},
+        "hg": {"kernel": w_hh[:, 2*H:3*H], "bias": b[2*H:3*H]},
+        "ho": {"kernel": w_hh[:, 3*H:], "bias": b[3*H:]},
+    }}
+    # flax carry is (c, h)
+    c = jnp.zeros((B, H))
+    h = jnp.zeros((B, H))
+    outs = []
+    for t in range(T):
+        (c, h), y = cell.apply(flax_params, (c, h), x[t])
+        outs.append(y)
+    ref = jnp.stack(outs)
+    ys, _ = model.apply(params, x)
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_o1_casts_rnn_matmuls():
+    """Under an O1 cast context the recurrence runs in bf16
+    (the rnn_compat capability: RNN compute follows the policy)."""
+    model = apex_rnn.GRU(hidden_size=H)
+    x = data()
+    params = model.init(jax.random.PRNGKey(0), x)
+    with amp.cast_context(amp.O1()):
+        ys, _ = model.apply(params, x)
+    assert ys.dtype == jnp.bfloat16
+    ys32, _ = model.apply(params, x)
+    np.testing.assert_allclose(np.asarray(ys, np.float32), np.asarray(ys32),
+                               atol=0.05)
+
+
+def test_initial_state_passthrough():
+    model = apex_rnn.Tanh(hidden_size=H)
+    x = data()
+    params = model.init(jax.random.PRNGKey(0), x)
+    h0 = jnp.ones((B, H))
+    ys, finals = model.apply(params, x, [h0])
+    ys_zero, _ = model.apply(params, x)
+    assert not np.allclose(np.asarray(ys[0]), np.asarray(ys_zero[0]))
