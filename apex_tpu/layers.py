@@ -1,0 +1,100 @@
+"""Policy-aware building-block layers.
+
+The reference made ``torch.nn`` layers mixed-precision-aware by
+monkey-patching the functions they call (``apex/amp/amp.py:90-101``); here
+the layers call :mod:`apex_tpu.amp.ops` directly, so the active O1 policy
+governs their compute dtype, and under O2/O3 they simply follow their
+(cast) param dtypes.  Convolutions run channels-last (NHWC) — the TPU-native
+layout (the reference needed dedicated ``_c_last`` CUDA kernels for this;
+see ``csrc/welford.cu:586-829``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.amp import ops as amp_ops
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+class Conv(nn.Module):
+    """NHWC convolution whose compute routes through the policy-cast op
+    layer (O1 whitelists conv, ``lists/functional_overrides.py:18-27``)."""
+
+    features: int
+    kernel_size: Union[int, Tuple[int, int]] = 3
+    strides: Union[int, Tuple[int, int]] = 1
+    padding: Union[str, int] = "SAME"
+    use_bias: bool = False
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = _pair(self.kernel_size)
+        kernel = self.param(
+            "kernel", nn.initializers.variance_scaling(2.0, "fan_out",
+                                                       "normal"),
+            (kh, kw, x.shape[-1], self.features), self.param_dtype)
+        padding = self.padding
+        if isinstance(padding, int):
+            padding = [(padding, padding), (padding, padding)]
+        y = amp_ops.conv_general_dilated(
+            x, kernel, window_strides=_pair(self.strides), padding=padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), self.param_dtype)
+            y = y + bias.astype(y.dtype)
+        return y
+
+
+class ConvTranspose(nn.Module):
+    """NHWC transposed convolution (DCGAN generator upsampling)."""
+
+    features: int
+    kernel_size: Union[int, Tuple[int, int]] = 4
+    strides: Union[int, Tuple[int, int]] = 2
+    padding: Union[str, Sequence[Tuple[int, int]]] = "SAME"
+    use_bias: bool = False
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = _pair(self.kernel_size)
+        kernel = self.param(
+            "kernel", nn.initializers.variance_scaling(1.0, "fan_in",
+                                                       "normal"),
+            (kh, kw, x.shape[-1], self.features), self.param_dtype)
+        y = amp_ops.conv_transpose(
+            x, kernel, strides=_pair(self.strides), padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), self.param_dtype)
+            y = y + bias.astype(y.dtype)
+        return y
+
+
+class Dense(nn.Module):
+    """Linear layer via the policy-cast matmul."""
+
+    features: int
+    use_bias: bool = True
+    param_dtype: Any = jnp.float32
+    kernel_init: Any = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", self.kernel_init,
+                            (x.shape[-1], self.features), self.param_dtype)
+        bias = (self.param("bias", nn.initializers.zeros,
+                           (self.features,), self.param_dtype)
+                if self.use_bias else None)
+        return amp_ops.linear(x, kernel, bias)

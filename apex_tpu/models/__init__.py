@@ -1,5 +1,29 @@
-"""apex_tpu.models — reference workload model families (BASELINE configs)."""
+"""apex_tpu.models — reference workload model families (BASELINE configs).
 
+1. MLP (MNIST, amp O1) — :mod:`apex_tpu.models.mlp`
+2./3. ResNet-50 (ImageNet, O2 + FusedAdam; DDP + SyncBN) —
+   :mod:`apex_tpu.models.resnet`
+4. BERT-large (FusedLAMB + FusedLayerNorm) — :mod:`apex_tpu.models.bert`
+5. DCGAN (two-loss-scaler GAN) — :mod:`apex_tpu.models.dcgan`
+"""
+
+from apex_tpu.models.bert import (
+    BertConfig,
+    BertForPreTraining,
+    BertModel,
+    bert_base,
+    bert_large,
+    bert_tiny,
+    pretraining_loss,
+)
+from apex_tpu.models.dcgan import Discriminator, Generator, gan_losses
 from apex_tpu.models.mlp import MLP, AmpDense, cross_entropy_loss
+from apex_tpu.models.resnet import ResNet, ResNet18, ResNet50
 
-__all__ = ["MLP", "AmpDense", "cross_entropy_loss"]
+__all__ = [
+    "MLP", "AmpDense", "cross_entropy_loss",
+    "ResNet", "ResNet50", "ResNet18",
+    "BertConfig", "BertModel", "BertForPreTraining",
+    "bert_large", "bert_base", "bert_tiny", "pretraining_loss",
+    "Generator", "Discriminator", "gan_losses",
+]

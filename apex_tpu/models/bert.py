@@ -1,0 +1,155 @@
+"""BERT — the FusedLayerNorm + FusedLAMB pretraining workload.
+
+Port of BASELINE config 4 ("BERT-large pretraining FusedLAMB +
+FusedLayerNorm (v5e-16)").  The reference carries no BERT model (its role
+there is played by downstream users pairing apex's FusedLayerNorm/LAMB
+kernels with their own BERT); the model here is authored TPU-first:
+
+- every LayerNorm is :class:`apex_tpu.normalization.FusedLayerNorm`
+  (Pallas-fused on TPU, fp32 statistics);
+- attention/FFN matmuls route through the policy-cast op layer, softmax in
+  fp32 (``lists/functional_overrides.py:29-65`` puts softmax on the fp32
+  list);
+- shapes default to BERT-large (hidden 1024, 24 layers, 16 heads).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.amp import ops as amp_ops
+from apex_tpu.layers import Dense
+from apex_tpu.normalization import FusedLayerNorm
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: int = 4096
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+
+
+def bert_large() -> BertConfig:
+    return BertConfig()
+
+
+def bert_base() -> BertConfig:
+    return BertConfig(hidden_size=768, num_layers=12, num_heads=12,
+                      intermediate_size=3072)
+
+
+def bert_tiny() -> BertConfig:
+    """Test-scale config."""
+    return BertConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                      num_heads=4, intermediate_size=256,
+                      max_position_embeddings=64)
+
+
+class SelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        c = self.cfg
+        head_dim = c.hidden_size // c.num_heads
+        qkv = Dense(3 * c.hidden_size, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(t.shape[0], t.shape[1], c.num_heads, head_dim)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        scores = amp_ops.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(head_dim)
+        if mask is not None:
+            # mask: (B, L) 1 = attend; large negative in fp32
+            bias = (1.0 - mask[:, None, None, :].astype(jnp.float32)) * -1e9
+            scores = scores.astype(jnp.float32) + bias
+        probs = amp_ops.softmax(scores, axis=-1).astype(v.dtype)
+        out = amp_ops.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = out.reshape(x.shape[0], x.shape[1], c.hidden_size)
+        return Dense(c.hidden_size, name="out")(out)
+
+
+class TransformerLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        c = self.cfg
+        a = SelfAttention(c, name="attention")(x, mask)
+        x = FusedLayerNorm(c.hidden_size, eps=c.layer_norm_eps,
+                           name="attention_ln")(x + a)
+        h = Dense(c.intermediate_size, name="ffn_in")(x)
+        h = nn.gelu(h)
+        h = Dense(c.hidden_size, name="ffn_out")(h)
+        return FusedLayerNorm(c.hidden_size, eps=c.layer_norm_eps,
+                              name="ffn_ln")(x + h)
+
+
+class BertModel(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        c = self.cfg
+        B, L = input_ids.shape
+        tok = nn.Embed(c.vocab_size, c.hidden_size, name="tok_emb")(input_ids)
+        pos = nn.Embed(c.max_position_embeddings, c.hidden_size,
+                       name="pos_emb")(jnp.arange(L)[None, :])
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        seg = nn.Embed(c.type_vocab_size, c.hidden_size,
+                       name="seg_emb")(token_type_ids)
+        x = FusedLayerNorm(c.hidden_size, eps=c.layer_norm_eps,
+                           name="emb_ln")(tok + pos + seg)
+        for i in range(c.num_layers):
+            x = TransformerLayer(c, name=f"layer_{i}")(x, attention_mask)
+        return x
+
+
+class BertForPreTraining(nn.Module):
+    """MLM + NSP heads over the encoder (the pretraining objective LAMB was
+    built for)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        c = self.cfg
+        seq = BertModel(c, name="bert")(input_ids, token_type_ids,
+                                        attention_mask)
+        # MLM head: transform + LN + vocab projection.
+        h = Dense(c.hidden_size, name="mlm_transform")(seq)
+        h = nn.gelu(h)
+        h = FusedLayerNorm(c.hidden_size, eps=c.layer_norm_eps,
+                           name="mlm_ln")(h)
+        mlm_logits = Dense(c.vocab_size, name="mlm_decoder")(h)
+        # NSP head over the [CLS] (first) token.
+        pooled = jnp.tanh(Dense(c.hidden_size, name="pooler")(seq[:, 0]))
+        nsp_logits = Dense(2, name="nsp")(pooled)
+        return mlm_logits, nsp_logits
+
+
+def pretraining_loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels,
+                     mlm_mask):
+    """Masked-LM + NSP cross entropy in fp32; ``mlm_mask`` selects the
+    masked positions (1.0 where a prediction is scored)."""
+    logp = amp_ops.log_softmax(mlm_logits, axis=-1)
+    mlm_ll = jnp.take_along_axis(logp, mlm_labels[..., None],
+                                 axis=-1).squeeze(-1)
+    denom = jnp.maximum(mlm_mask.sum(), 1.0)
+    mlm_loss = -(mlm_ll * mlm_mask).sum() / denom
+    nsp_logp = amp_ops.log_softmax(nsp_logits, axis=-1)
+    nsp_loss = -jnp.mean(
+        jnp.take_along_axis(nsp_logp, nsp_labels[:, None], axis=-1))
+    return mlm_loss + nsp_loss

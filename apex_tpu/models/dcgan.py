@@ -1,0 +1,77 @@
+"""DCGAN generator/discriminator — the two-loss-scaler workload.
+
+Port of BASELINE config 5 ("examples/dcgan amp O1 two-optimizer GAN"): the
+reference's ``examples/dcgan`` README is a stub (SURVEY.md §0), so the
+workload is defined by the amp machinery it exercises — ``num_losses=2``
+with independent ``loss_id`` scalers (``apex/amp/handle.py:53-58``) across a
+generator and a discriminator optimizer.  Architecture follows the standard
+DCGAN recipe in NHWC.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.layers import Conv, ConvTranspose
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+
+class Generator(nn.Module):
+    """z (B, zdim) → image (B, S, S, channels) with S = 8 * 2**n_up."""
+
+    feature_maps: int = 64
+    channels: int = 3
+    n_upsample: int = 2
+
+    @nn.compact
+    def __call__(self, z, train: bool = True):
+        f = self.feature_maps * (2 ** self.n_upsample)
+        x = nn.Dense(4 * 4 * f, name="project")(z)
+        x = x.reshape(z.shape[0], 4, 4, f)
+        x = SyncBatchNorm(name="bn_in")(x, use_running_average=not train)
+        x = nn.relu(x)
+        for i in range(self.n_upsample):
+            f //= 2
+            x = ConvTranspose(f, 4, strides=2, name=f"up{i}")(x)
+            x = SyncBatchNorm(name=f"bn{i}")(x, use_running_average=not train)
+            x = nn.relu(x)
+        x = ConvTranspose(self.channels, 4, strides=2, name="to_rgb")(x)
+        return jnp.tanh(x)
+
+
+class Discriminator(nn.Module):
+    feature_maps: int = 64
+    n_down: int = 3
+
+    @nn.compact
+    def __call__(self, img, train: bool = True):
+        x = img
+        f = self.feature_maps
+        for i in range(self.n_down):
+            x = Conv(f, 4, strides=2, name=f"down{i}", use_bias=True)(x)
+            if i > 0:
+                x = SyncBatchNorm(name=f"bn{i}")(
+                    x, use_running_average=not train)
+            x = nn.leaky_relu(x, 0.2)
+            f *= 2
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(1, name="logit")(x)  # logits; loss uses with-logits
+
+
+def gan_losses(d_real_logits, d_fake_logits, g_fake_logits):
+    """Non-saturating GAN losses in fp32 via with-logits BCE (the banned-op
+    guidance: never probability-space BCE in half,
+    ``functional_overrides.py:67-77``)."""
+    def bce_logits(logits, target):
+        logits = logits.astype(jnp.float32)
+        # log(1+exp(-|x|)) formulation, stable in fp32
+        return jnp.mean(jnp.maximum(logits, 0) - logits * target
+                        + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+    d_loss = bce_logits(d_real_logits, 1.0) + bce_logits(d_fake_logits, 0.0)
+    g_loss = bce_logits(g_fake_logits, 1.0)
+    return d_loss, g_loss

@@ -1,0 +1,99 @@
+"""ResNet-50 (v1.5) — the flagship ImageNet workload.
+
+Port of BASELINE configs 2 and 3 ("examples/imagenet ResNet-50 amp O2 +
+FusedAdam (single chip)" / "DDP + SyncBatchNorm (v5e-8)"); the reference's
+examples consume torchvision's resnet50 (``examples/imagenet/main_amp.py``),
+so the model itself is re-authored TPU-first:
+
+- channels-last (NHWC) layout throughout — the layout the reference's
+  ``_c_last`` SyncBN kernels existed for, and the MXU-friendly one;
+- v1.5 bottleneck (stride on the 3x3, like torchvision);
+- BatchNorms are :class:`apex_tpu.parallel.SyncBatchNorm` threaded with the
+  ``bn_axis_name`` / ``bn_process_group`` fields, making the model
+  ``convert_syncbn_model``-convertible (``apex/parallel/__init__.py:21-53``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.layers import Conv, Dense
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+
+class Bottleneck(nn.Module):
+    features: int               # base width; output is 4x
+    strides: int = 1
+    downsample: bool = False
+    bn_axis_name: Optional[str] = None
+    bn_process_group: Optional[Sequence[Sequence[int]]] = None
+
+    def _bn(self, name):
+        return SyncBatchNorm(axis_name=self.bn_axis_name,
+                             process_group=self.bn_process_group,
+                             momentum=0.1, epsilon=1e-5, name=name)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        residual = x
+        y = Conv(self.features, 1, name="conv1")(x)
+        y = self._bn("bn1")(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = Conv(self.features, 3, strides=self.strides, name="conv2")(y)
+        y = self._bn("bn2")(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = Conv(self.features * 4, 1, name="conv3")(y)
+        y = self._bn("bn3")(y, use_running_average=not train)
+        if self.downsample:
+            residual = Conv(self.features * 4, 1, strides=self.strides,
+                            name="downsample_conv")(x)
+            residual = self._bn("downsample_bn")(
+                residual, use_running_average=not train)
+        return nn.relu(y + residual.astype(y.dtype))
+
+
+class ResNet(nn.Module):
+    """ResNet-v1.5; ``stage_sizes=(3,4,6,3)`` is ResNet-50."""
+
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)
+    num_classes: int = 1000
+    width: int = 64
+    bn_axis_name: Optional[str] = None
+    bn_process_group: Optional[Sequence[Sequence[int]]] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        y = Conv(self.width, 7, strides=2, name="stem_conv")(x)
+        y = SyncBatchNorm(axis_name=self.bn_axis_name,
+                          process_group=self.bn_process_group,
+                          name="stem_bn")(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = nn.max_pool(y, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block in range(n_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                y = Bottleneck(
+                    features=self.width * (2 ** stage),
+                    strides=strides,
+                    downsample=(block == 0),
+                    bn_axis_name=self.bn_axis_name,
+                    bn_process_group=self.bn_process_group,
+                    name=f"stage{stage}_block{block}",
+                )(y, train=train)
+        y = jnp.mean(y, axis=(1, 2))  # global average pool
+        return Dense(self.num_classes,
+                     kernel_init=nn.initializers.normal(0.01), name="fc")(y)
+
+
+def ResNet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), **kw)
+
+
+def ResNet18(**kw) -> ResNet:
+    """Smaller sibling for tests; still bottleneck blocks (keeps one code
+    path) — (2,2,2,2) stages."""
+    return ResNet(stage_sizes=(2, 2, 2, 2), **kw)

@@ -143,7 +143,10 @@ class SyncBatchNorm(nn.Module):
 
         local_mean, local_var, local_count = welford_mean_var(x, reduce_axes)
 
-        if self.axis_name is not None and self.process_group is None:
+        # During init there is no bound mesh axis to reduce over; local stats
+        # are fine (flax's BatchNorm does the same).
+        sync = self.axis_name is not None and not self.is_initializing()
+        if sync and self.process_group is None:
             # Whole-axis sync: Chan's merge expressed as two psum rounds —
             # the same math as gathering per-rank stats and merging
             # (welford.cu:557-585), but psum outputs are replication-typed,
@@ -156,7 +159,7 @@ class SyncBatchNorm(nn.Module):
             m2 = lax.psum(c * local_var + c * jnp.square(local_mean - mean),
                           self.axis_name)
             var = m2 / total_count
-        elif self.axis_name is not None:
+        elif sync:
             # Grouped sync: grouped psum is unsupported under VMA checking,
             # so use the reference's own recipe — all_gather per-group stats
             # then Chan-merge locally (optimized_sync_batchnorm_kernel.py:

@@ -1,0 +1,102 @@
+"""BASELINE config 5: DCGAN with two optimizers and two loss scalers.
+
+The workload the reference's stub ``examples/dcgan`` was meant to carry: a
+generator and a discriminator, each with its own optimizer, trained with
+*independent* dynamic loss scalers — the ``num_losses`` / ``loss_id``
+machinery (``apex/amp/handle.py:53-58``).  Here each network gets its own
+:class:`~apex_tpu.amp.Amp` (the functional analog of two loss_ids), so an
+overflow in D's backward never shrinks G's scale.
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu import amp
+from apex_tpu.models.dcgan import Discriminator, Generator, gan_losses
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--opt-level", default="O1")
+    p.add_argument("--zdim", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--lr", type=float, default=2e-4)
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--print-freq", type=int, default=20)
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    n_up = {32: 2, 64: 3}[args.image_size]
+    G = Generator(feature_maps=64, n_upsample=n_up)
+    D = Discriminator(feature_maps=64, n_down=n_up + 1)
+
+    kz = jax.random.PRNGKey(0)
+    z0 = jax.random.normal(kz, (2, args.zdim))
+    img0 = jnp.zeros((2, args.image_size, args.image_size, 3))
+    gv = G.init(jax.random.PRNGKey(1), z0, train=True)
+    dv = D.init(jax.random.PRNGKey(2), img0, train=True)
+
+    adam = lambda: optax.adam(args.lr, b1=0.5, b2=0.999)
+    a_g = amp.initialize(optimizer=adam(), opt_level=args.opt_level)
+    a_d = amp.initialize(optimizer=adam(), opt_level=args.opt_level)
+    gs, ds = a_g.init(gv["params"]), a_d.init(dv["params"])
+    g_stats, d_stats = gv["batch_stats"], dv["batch_stats"]
+
+    def d_loss(dp, gp, z, real):
+        fake = G.apply({"params": gp, "batch_stats": g_stats}, z,
+                       train=True, mutable=["batch_stats"])[0]
+        d_real = D.apply({"params": dp, "batch_stats": d_stats}, real,
+                         train=True, mutable=["batch_stats"])[0]
+        d_fake = D.apply({"params": dp, "batch_stats": d_stats},
+                         jax.lax.stop_gradient(fake), train=True,
+                         mutable=["batch_stats"])[0]
+        loss, _ = gan_losses(d_real, d_fake, d_fake)
+        return loss
+
+    def g_loss(gp, dp, z):
+        fake = G.apply({"params": gp, "batch_stats": g_stats}, z,
+                       train=True, mutable=["batch_stats"])[0]
+        logits = D.apply({"params": dp, "batch_stats": d_stats}, fake,
+                         train=True, mutable=["batch_stats"])[0]
+        _, loss = gan_losses(logits, logits, logits)
+        return loss
+
+    @jax.jit
+    def train_step(gs, ds, z, real):
+        # D step (loss_id 0 of the reference's shared-model two-scaler run)
+        def scaled_d(dp):
+            l = a_d.run(d_loss, dp, a_g.model_params(gs), z, real)
+            return a_d.scale_loss(l, ds), l
+        d_grads, dl = jax.grad(scaled_d, has_aux=True)(a_d.model_params(ds))
+        ds, d_info = a_d.apply_gradients(ds, d_grads)
+
+        # G step (loss_id 1)
+        def scaled_g(gp):
+            l = a_g.run(g_loss, gp, a_d.model_params(ds), z)
+            return a_g.scale_loss(l, gs), l
+        g_grads, gl = jax.grad(scaled_g, has_aux=True)(a_g.model_params(gs))
+        gs, g_info = a_g.apply_gradients(gs, g_grads)
+        return gs, ds, dl, gl, d_info, g_info
+
+    for i in range(args.steps):
+        k = jax.random.PRNGKey(100 + i)
+        z = jax.random.normal(k, (args.batch_size, args.zdim))
+        # synthetic "real" images: smooth blobs
+        real = jnp.tanh(jax.random.normal(
+            k, (args.batch_size, args.image_size, args.image_size, 3)))
+        gs, ds, dl, gl, d_info, g_info = train_step(gs, ds, z, real)
+        if i % args.print_freq == 0 or i == args.steps - 1:
+            print(f"step {i:4d}  D {float(dl):.4f} G {float(gl):.4f}  "
+                  f"scales D {float(d_info['loss_scale']):.0f} "
+                  f"G {float(g_info['loss_scale']):.0f}")
+
+
+if __name__ == "__main__":
+    main()

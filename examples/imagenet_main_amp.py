@@ -1,0 +1,158 @@
+"""BASELINE configs 2 & 3: ImageNet ResNet-50 with amp O2 (+FusedAdam) and
+data-parallel + SyncBatchNorm.
+
+Port of ``examples/imagenet/main_amp.py`` / ``tests/L1/common/main_amp.py``:
+the flag surface (``--opt-level``, ``--loss-scale``,
+``--keep-batchnorm-fp32``, ``--fused-adam``, ``--sync-bn``, ``--prof``,
+``--deterministic``) and the throughput/AverageMeter reporting carry over;
+process-group DDP becomes a ``shard_map`` over the ``("data",)`` mesh with
+:class:`apex_tpu.parallel.DistributedDataParallel` reduction.
+
+Data is synthetic by default (this environment has no ImageNet); plug a real
+loader into ``data_iter`` for convergence runs (LR schedule per the
+reference "should yield 76%": 0.1·B/256, /10 at epochs 30/60/80).
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import amp
+from apex_tpu.models.resnet import ResNet50
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.parallel import (
+    DistributedDataParallel,
+    convert_syncbn_model,
+    data_parallel_mesh,
+)
+from apex_tpu.utils import maybe_print
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--arch", default="resnet50")
+    p.add_argument("-b", "--batch-size", type=int, default=128,
+                   help="per-device batch")
+    p.add_argument("--lr", type=float, default=None,
+                   help="default: 0.1 (SGD) or 1e-3 (FusedAdam)")
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--opt-level", default="O2")
+    p.add_argument("--loss-scale", default=None)
+    p.add_argument("--keep-batchnorm-fp32", default=None)
+    p.add_argument("--fused-adam", action="store_true")
+    p.add_argument("--sync-bn", action="store_true")
+    p.add_argument("--dp", action="store_true",
+                   help="data-parallel over all visible devices")
+    p.add_argument("--prof", type=int, default=0,
+                   help="profile N steps then exit (reference --prof)")
+    p.add_argument("--deterministic", action="store_true")
+    p.add_argument("--print-freq", type=int, default=10)
+    return p.parse_args()
+
+
+class AverageMeter:
+    """(reference ``main_amp.py:336-372``)"""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.val = self.sum = self.count = self.avg = 0.0
+
+    def update(self, val, n=1):
+        self.val = val
+        self.sum += val * n
+        self.count += n
+        self.avg = self.sum / self.count
+
+
+def synthetic_batch(key, batch, size):
+    x = jax.random.normal(key, (batch, size, size, 3), jnp.float32)
+    y = jax.random.randint(key, (batch,), 0, 1000)
+    return x, y
+
+
+def main():
+    args = parse_args()
+    if args.deterministic:
+        seed = 0
+    else:
+        seed = int(time.time())
+
+    n_dev = len(jax.devices()) if args.dp else 1
+    model = ResNet50()
+    if args.sync_bn:
+        model = convert_syncbn_model(model, axis_name="data")
+        maybe_print("using SyncBatchNorm over the data axis")
+
+    x0, _ = synthetic_batch(jax.random.PRNGKey(0), 2, args.image_size)
+    variables = model.init(jax.random.PRNGKey(seed), x0, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    if args.fused_adam:
+        tx = FusedAdam(lr=args.lr if args.lr is not None else 1e-3)
+    else:
+        tx = optax.sgd(args.lr if args.lr is not None else 0.1, momentum=0.9)
+    a = amp.initialize(optimizer=tx, opt_level=args.opt_level,
+                       loss_scale=args.loss_scale,
+                       keep_batchnorm_fp32=args.keep_batchnorm_fp32)
+    state = a.init(params)
+
+    def loss_fn(p, x, y):
+        logits, _ = model.apply({"params": p, "batch_stats": batch_stats},
+                                x, train=True, mutable=["batch_stats"])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+
+    if args.dp:
+        mesh = data_parallel_mesh()
+        ddp = DistributedDataParallel(axis_name="data")
+        inner = amp.make_train_step(a, loss_fn, axis_name="data",
+                                    reduce_fn=ddp.reduce)
+
+        def sharded(s, x, y):
+            s2, m = inner(s, x, y)
+            return s2, jax.lax.pmean(m["loss"], "data"), m["loss_scale"]
+
+        step = jax.jit(jax.shard_map(
+            sharded, mesh=mesh, in_specs=(P(), P("data"), P("data")),
+            out_specs=(P(), P(), P())))
+    else:
+        inner = amp.make_train_step(a, loss_fn)
+
+        def step(s, x, y):
+            s2, m = inner(s, x, y)
+            return s2, m["loss"], m["loss_scale"]
+
+        step = jax.jit(step)
+
+    global_batch = args.batch_size * n_dev
+    steps = args.prof or args.steps
+    batch_time, losses = AverageMeter(), AverageMeter()
+    end = time.time()
+    for i in range(steps):
+        kx = jax.random.PRNGKey(seed + i + 1)
+        x, y = synthetic_batch(kx, global_batch, args.image_size)
+        state, loss, scale = step(state, x, y)
+        loss = float(loss)  # sync point, as in the reference's loss print
+        batch_time.update(time.time() - end)
+        end = time.time()
+        losses.update(loss, global_batch)
+        if i % args.print_freq == 0 or i == steps - 1:
+            maybe_print(
+                f"step {i:4d}  loss {losses.val:.4f} ({losses.avg:.4f})  "
+                f"scale {float(scale):.0f}  "
+                f"{global_batch / batch_time.val:.0f} img/s "
+                f"({global_batch / max(batch_time.avg, 1e-9):.0f} avg)")
+    maybe_print(f"Speed: {global_batch / max(batch_time.avg, 1e-9):.1f} "
+                "img/s total")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,206 @@
+"""L1-style end-to-end workload tests at test scale.
+
+Each of the five BASELINE configs gets a miniature end-to-end run: forward,
+backward, one or more amp train steps, loss finite and decreasing where
+meaningful.  The full-scale entry points live in ``examples/``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import amp
+from apex_tpu.models import (
+    BertForPreTraining,
+    Discriminator,
+    Generator,
+    ResNet18,
+    bert_tiny,
+    gan_losses,
+    pretraining_loss,
+)
+from apex_tpu.optimizers import FusedAdam, fused_lamb
+from apex_tpu.parallel import DistributedDataParallel, data_parallel_mesh
+
+
+class TestResNet:
+    def setup_method(self, _):
+        self.model = ResNet18(num_classes=10, width=16)
+        self.x = jnp.asarray(np.random.RandomState(0)
+                             .randn(4, 32, 32, 3).astype(np.float32))
+
+    def init(self):
+        return self.model.init(jax.random.PRNGKey(0), self.x, train=True)
+
+    def test_forward_shapes_and_stats(self):
+        variables = self.init()
+        logits, updated = self.model.apply(
+            variables, self.x, train=True, mutable=["batch_stats"])
+        assert logits.shape == (4, 10)
+        assert bool(jnp.isfinite(logits).all())
+        # running stats moved
+        stem_mean = updated["batch_stats"]["stem_bn"]["mean"]
+        assert float(jnp.abs(stem_mean).max()) > 0
+
+    def test_o2_train_step_with_fused_adam(self):
+        variables = self.init()
+        params, batch_stats = variables["params"], variables["batch_stats"]
+        a = amp.initialize(optimizer=FusedAdam(lr=1e-3), opt_level="O2",
+                           verbosity=0)
+        state = a.init(params)
+        y = jnp.asarray(np.random.RandomState(1).randint(0, 10, (4,)))
+
+        def loss_fn(p, x, y):
+            logits, _ = self.model.apply(
+                {"params": p, "batch_stats": batch_stats}, x, train=True,
+                mutable=["batch_stats"])
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+
+        step = jax.jit(amp.make_train_step(a, loss_fn))
+        losses = []
+        for _ in range(3):
+            state, m = step(state, self.x, y)
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_bn_params_stay_fp32_under_o2(self):
+        variables = self.init()
+        a = amp.initialize(optimizer=optax.sgd(0.1), opt_level="O2",
+                           verbosity=0)
+        state = a.init(variables["params"])
+        compute = a.model_params(state)
+        stem_bn_scale = compute["stem_bn"]["scale"]
+        conv_kernel = compute["stem_conv"]["kernel"]
+        assert stem_bn_scale.dtype == jnp.float32   # keep_batchnorm_fp32
+        assert conv_kernel.dtype == jnp.bfloat16
+
+    def test_sync_bn_conversion_and_ddp_step(self):
+        from apex_tpu.parallel import convert_syncbn_model
+        mesh = data_parallel_mesh()
+        sync_model = convert_syncbn_model(self.model, axis_name="data")
+        assert sync_model.bn_axis_name == "data"
+        variables = sync_model.init(jax.random.PRNGKey(0), self.x, train=True)
+        x8 = jnp.asarray(np.random.RandomState(2)
+                         .randn(8, 32, 32, 3).astype(np.float32))
+
+        def fwd(v, xb):
+            logits, _ = sync_model.apply(v, xb, train=True,
+                                         mutable=["batch_stats"])
+            return logits
+
+        logits = jax.shard_map(
+            fwd, mesh=mesh, in_specs=(P(), P("data")),
+            out_specs=P("data"))(variables, x8)
+        assert logits.shape == (8, 10)
+        assert bool(jnp.isfinite(logits).all())
+
+
+class TestBert:
+    def setup_method(self, _):
+        self.cfg = bert_tiny()
+        self.model = BertForPreTraining(self.cfg)
+        rng = np.random.RandomState(0)
+        B, L = 2, 16
+        self.ids = jnp.asarray(rng.randint(0, self.cfg.vocab_size, (B, L)))
+        self.mask = jnp.ones((B, L), jnp.int32)
+        self.mlm_labels = jnp.asarray(
+            rng.randint(0, self.cfg.vocab_size, (B, L)))
+        self.mlm_mask = jnp.asarray((rng.rand(B, L) < 0.15)
+                                    .astype(np.float32))
+        self.nsp = jnp.asarray(rng.randint(0, 2, (B,)))
+
+    def test_forward(self):
+        variables = self.model.init(jax.random.PRNGKey(0), self.ids,
+                                    attention_mask=self.mask)
+        mlm, nsp = self.model.apply(variables, self.ids,
+                                    attention_mask=self.mask)
+        assert mlm.shape == (2, 16, self.cfg.vocab_size)
+        assert nsp.shape == (2, 2)
+
+    def test_lamb_pretraining_steps(self):
+        variables = self.model.init(jax.random.PRNGKey(0), self.ids,
+                                    attention_mask=self.mask)
+        a = amp.initialize(optimizer=fused_lamb(learning_rate=1e-3),
+                           opt_level="O2", verbosity=0)
+        state = a.init(variables["params"])
+
+        def loss_fn(p, ids, mask, mlm_labels, mlm_mask, nsp):
+            mlm, nspl = self.model.apply({"params": p}, ids,
+                                         attention_mask=mask)
+            return pretraining_loss(mlm, nspl, mlm_labels=mlm_labels,
+                                    nsp_labels=nsp, mlm_mask=mlm_mask)
+
+        step = jax.jit(amp.make_train_step(a, loss_fn))
+        losses = []
+        for _ in range(3):
+            state, m = step(state, self.ids, self.mask, self.mlm_labels,
+                            self.mlm_mask, self.nsp)
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+
+class TestDCGAN:
+    def test_two_loss_scaler_training(self):
+        """The num_losses=2 machinery: independent scalers for G and D."""
+        G, D = Generator(feature_maps=8, n_upsample=1), \
+            Discriminator(feature_maps=8, n_down=2)
+        rng = np.random.RandomState(0)
+        z = jnp.asarray(rng.randn(4, 16).astype(np.float32))
+        real = jnp.asarray(rng.rand(4, 16, 16, 3).astype(np.float32) * 2 - 1)
+
+        gv = G.init(jax.random.PRNGKey(0), z, train=True)
+        dv = D.init(jax.random.PRNGKey(1), real, train=True)
+
+        a_g = amp.initialize(optimizer=optax.adam(2e-4), opt_level="O1",
+                             verbosity=0)
+        a_d = amp.initialize(optimizer=optax.adam(2e-4), opt_level="O1",
+                             verbosity=0)
+        gs = a_g.init(gv["params"])
+        ds = a_d.init(dv["params"])
+
+        def d_loss_fn(dp, gp):
+            fake = G.apply({"params": gp, "batch_stats": gv["batch_stats"]},
+                           z, train=True, mutable=["batch_stats"])[0]
+            d_real = D.apply({"params": dp, "batch_stats": dv["batch_stats"]},
+                             real, train=True, mutable=["batch_stats"])[0]
+            d_fake = D.apply({"params": dp, "batch_stats": dv["batch_stats"]},
+                             fake, train=True, mutable=["batch_stats"])[0]
+            d_loss, _ = gan_losses(d_real, d_fake, d_fake)
+            return d_loss
+
+        def g_loss_fn(gp, dp):
+            fake = G.apply({"params": gp, "batch_stats": gv["batch_stats"]},
+                           z, train=True, mutable=["batch_stats"])[0]
+            g_logits = D.apply(
+                {"params": dp, "batch_stats": dv["batch_stats"]},
+                fake, train=True, mutable=["batch_stats"])[0]
+            _, g_loss = gan_losses(g_logits, g_logits, g_logits)
+            return g_loss
+
+        @jax.jit
+        def step(gs, ds):
+            d_grads = jax.grad(
+                lambda dp: a_d.scaler.scale_loss(
+                    d_loss_fn(dp, a_g.model_params(gs)),
+                    ds.scaler_states[0]))(a_d.model_params(ds))
+            ds2, d_info = a_d.apply_gradients(ds, d_grads)
+            g_grads = jax.grad(
+                lambda gp: a_g.scaler.scale_loss(
+                    g_loss_fn(gp, a_d.model_params(ds2)),
+                    gs.scaler_states[0]))(a_g.model_params(gs))
+            gs2, g_info = a_g.apply_gradients(gs, g_grads)
+            return gs2, ds2, d_info, g_info
+
+        for _ in range(2):
+            gs, ds, d_info, g_info = step(gs, ds)
+        assert not bool(d_info["overflow"])
+        assert not bool(g_info["overflow"])
+        # scalers advanced independently
+        assert float(ds.scaler_states[0].loss_scale) == 2.0 ** 16
+        assert float(gs.scaler_states[0].loss_scale) == 2.0 ** 16
