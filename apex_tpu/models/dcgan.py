@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
-from apex_tpu.layers import Conv, ConvTranspose
+from apex_tpu.layers import Conv, ConvTranspose, Dense
 from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
 
 
@@ -30,7 +30,7 @@ class Generator(nn.Module):
     @nn.compact
     def __call__(self, z, train: bool = True):
         f = self.feature_maps * (2 ** self.n_upsample)
-        x = nn.Dense(4 * 4 * f, name="project")(z)
+        x = Dense(4 * 4 * f, name="project")(z)
         x = x.reshape(z.shape[0], 4, 4, f)
         x = SyncBatchNorm(name="bn_in")(x, use_running_average=not train)
         x = nn.relu(x)
@@ -59,7 +59,7 @@ class Discriminator(nn.Module):
             x = nn.leaky_relu(x, 0.2)
             f *= 2
         x = x.reshape(x.shape[0], -1)
-        return nn.Dense(1, name="logit")(x)  # logits; loss uses with-logits
+        return Dense(1, name="logit")(x)  # logits; loss uses with-logits
 
 
 def gan_losses(d_real_logits, d_fake_logits, g_fake_logits):

@@ -15,23 +15,12 @@ import jax.numpy as jnp
 import flax.linen as nn
 
 from apex_tpu.amp import ops as amp_ops
+from apex_tpu.layers import Dense
 
-
-class AmpDense(nn.Module):
+class AmpDense(Dense):
     """Dense layer whose matmul is policy-cast (O1 whitelists ``linear``,
-    reference ``functional_overrides.py:18-27``)."""
-
-    features: int
-    use_bias: bool = True
-
-    @nn.compact
-    def __call__(self, x):
-        kernel = self.param("kernel", nn.initializers.lecun_normal(),
-                            (x.shape[-1], self.features), jnp.float32)
-        bias = (self.param("bias", nn.initializers.zeros,
-                           (self.features,), jnp.float32)
-                if self.use_bias else None)
-        return amp_ops.linear(x, kernel, bias)
+    reference ``functional_overrides.py:18-27``).  Subclass (not alias) so
+    Flax keeps deriving ``AmpDense_N`` param scopes."""
 
 
 class MLP(nn.Module):

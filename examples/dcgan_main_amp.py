@@ -26,7 +26,8 @@ def parse_args():
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--steps", type=int, default=200)
     p.add_argument("--lr", type=float, default=2e-4)
-    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--image-size", type=int, default=32,
+                   choices=[32, 64])
     p.add_argument("--print-freq", type=int, default=20)
     return p.parse_args()
 
@@ -49,41 +50,46 @@ def main():
     gs, ds = a_g.init(gv["params"]), a_d.init(dv["params"])
     g_stats, d_stats = gv["batch_stats"], dv["batch_stats"]
 
-    def d_loss(dp, gp, z, real):
-        fake = G.apply({"params": gp, "batch_stats": g_stats}, z,
-                       train=True, mutable=["batch_stats"])[0]
-        d_real = D.apply({"params": dp, "batch_stats": d_stats}, real,
-                         train=True, mutable=["batch_stats"])[0]
-        d_fake = D.apply({"params": dp, "batch_stats": d_stats},
-                         jax.lax.stop_gradient(fake), train=True,
-                         mutable=["batch_stats"])[0]
+    def d_loss(dp, gp, g_stats, d_stats, z, real):
+        fake, g_mut = G.apply({"params": gp, "batch_stats": g_stats}, z,
+                              train=True, mutable=["batch_stats"])
+        d_real, d_mut = D.apply({"params": dp, "batch_stats": d_stats},
+                                real, train=True, mutable=["batch_stats"])
+        d_fake, d_mut = D.apply(
+            {"params": dp, "batch_stats": d_mut["batch_stats"]},
+            jax.lax.stop_gradient(fake), train=True,
+            mutable=["batch_stats"])
         loss, _ = gan_losses(d_real, d_fake, d_fake)
-        return loss
+        return loss, (g_mut["batch_stats"], d_mut["batch_stats"])
 
-    def g_loss(gp, dp, z):
-        fake = G.apply({"params": gp, "batch_stats": g_stats}, z,
-                       train=True, mutable=["batch_stats"])[0]
-        logits = D.apply({"params": dp, "batch_stats": d_stats}, fake,
-                         train=True, mutable=["batch_stats"])[0]
+    def g_loss(gp, dp, g_stats, d_stats, z):
+        fake, g_mut = G.apply({"params": gp, "batch_stats": g_stats}, z,
+                              train=True, mutable=["batch_stats"])
+        logits, d_mut = D.apply({"params": dp, "batch_stats": d_stats},
+                                fake, train=True, mutable=["batch_stats"])
         _, loss = gan_losses(logits, logits, logits)
-        return loss
+        return loss, (g_mut["batch_stats"], d_mut["batch_stats"])
 
     @jax.jit
-    def train_step(gs, ds, z, real):
+    def train_step(gs, ds, g_stats, d_stats, z, real):
         # D step (loss_id 0 of the reference's shared-model two-scaler run)
         def scaled_d(dp):
-            l = a_d.run(d_loss, dp, a_g.model_params(gs), z, real)
-            return a_d.scale_loss(l, ds), l
-        d_grads, dl = jax.grad(scaled_d, has_aux=True)(a_d.model_params(ds))
+            l, stats = a_d.run(d_loss, dp, a_g.model_params(gs),
+                               g_stats, d_stats, z, real)
+            return a_d.scale_loss(l, ds), (l, stats)
+        d_grads, (dl, (g_stats_, d_stats_)) = \
+            jax.grad(scaled_d, has_aux=True)(a_d.model_params(ds))
         ds, d_info = a_d.apply_gradients(ds, d_grads)
 
         # G step (loss_id 1)
         def scaled_g(gp):
-            l = a_g.run(g_loss, gp, a_d.model_params(ds), z)
-            return a_g.scale_loss(l, gs), l
-        g_grads, gl = jax.grad(scaled_g, has_aux=True)(a_g.model_params(gs))
+            l, stats = a_g.run(g_loss, gp, a_d.model_params(ds),
+                               g_stats_, d_stats_, z)
+            return a_g.scale_loss(l, gs), (l, stats)
+        g_grads, (gl, (g_stats_, d_stats_)) = \
+            jax.grad(scaled_g, has_aux=True)(a_g.model_params(gs))
         gs, g_info = a_g.apply_gradients(gs, g_grads)
-        return gs, ds, dl, gl, d_info, g_info
+        return gs, ds, g_stats_, d_stats_, dl, gl, d_info, g_info
 
     for i in range(args.steps):
         k = jax.random.PRNGKey(100 + i)
@@ -91,7 +97,8 @@ def main():
         # synthetic "real" images: smooth blobs
         real = jnp.tanh(jax.random.normal(
             k, (args.batch_size, args.image_size, args.image_size, 3)))
-        gs, ds, dl, gl, d_info, g_info = train_step(gs, ds, z, real)
+        gs, ds, g_stats, d_stats, dl, gl, d_info, g_info = train_step(
+            gs, ds, g_stats, d_stats, z, real)
         if i % args.print_freq == 0 or i == args.steps - 1:
             print(f"step {i:4d}  D {float(dl):.4f} G {float(gl):.4f}  "
                   f"scales D {float(d_info['loss_scale']):.0f} "

@@ -88,6 +88,10 @@ def main():
     n_dev = len(jax.devices()) if args.dp else 1
     model = ResNet50()
     if args.sync_bn:
+        if not args.dp:
+            raise SystemExit("--sync-bn requires --dp: the \"data\" mesh "
+                             "axis SyncBatchNorm reduces over only exists "
+                             "under the data-parallel shard_map")
         model = convert_syncbn_model(model, axis_name="data")
         maybe_print("using SyncBatchNorm over the data axis")
 
@@ -104,31 +108,42 @@ def main():
                        keep_batchnorm_fp32=args.keep_batchnorm_fp32)
     state = a.init(params)
 
-    def loss_fn(p, x, y):
-        logits, _ = model.apply({"params": p, "batch_stats": batch_stats},
-                                x, train=True, mutable=["batch_stats"])
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+    def make_loss_fn(stats):
+        def loss_fn(p, x, y):
+            logits, mut = model.apply({"params": p, "batch_stats": stats},
+                                      x, train=True, mutable=["batch_stats"])
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+            return loss, mut["batch_stats"]
+        return loss_fn
 
     if args.dp:
         mesh = data_parallel_mesh()
         ddp = DistributedDataParallel(axis_name="data")
-        inner = amp.make_train_step(a, loss_fn, axis_name="data",
-                                    reduce_fn=ddp.reduce)
 
-        def sharded(s, x, y):
+        def sharded(s, stats, x, y):
+            inner = amp.make_train_step(a, make_loss_fn(stats),
+                                        axis_name="data",
+                                        reduce_fn=ddp.reduce, has_aux=True)
             s2, m = inner(s, x, y)
-            return s2, jax.lax.pmean(m["loss"], "data"), m["loss_scale"]
+            # SyncBN already produces identical stats on every device; for
+            # local BN this averages the per-device running stats so one
+            # replicated copy carries forward (the reference checkpoints
+            # rank 0's copy instead).
+            stats2 = jax.lax.pmean(m["aux"], "data")
+            return (s2, stats2, jax.lax.pmean(m["loss"], "data"),
+                    m["loss_scale"])
 
         step = jax.jit(jax.shard_map(
-            sharded, mesh=mesh, in_specs=(P(), P("data"), P("data")),
-            out_specs=(P(), P(), P())))
+            sharded, mesh=mesh,
+            in_specs=(P(), P(), P("data"), P("data")),
+            out_specs=(P(), P(), P(), P())))
     else:
-        inner = amp.make_train_step(a, loss_fn)
-
-        def step(s, x, y):
+        def step(s, stats, x, y):
+            inner = amp.make_train_step(a, make_loss_fn(stats),
+                                        has_aux=True)
             s2, m = inner(s, x, y)
-            return s2, m["loss"], m["loss_scale"]
+            return s2, m["aux"], m["loss"], m["loss_scale"]
 
         step = jax.jit(step)
 
@@ -139,7 +154,7 @@ def main():
     for i in range(steps):
         kx = jax.random.PRNGKey(seed + i + 1)
         x, y = synthetic_batch(kx, global_batch, args.image_size)
-        state, loss, scale = step(state, x, y)
+        state, batch_stats, loss, scale = step(state, batch_stats, x, y)
         loss = float(loss)  # sync point, as in the reference's loss print
         batch_time.update(time.time() - end)
         end = time.time()
