@@ -63,6 +63,28 @@ def unpack(flat: jax.Array, meta: PackMeta) -> List[jax.Array]:
     return out
 
 
+def host_pack(arrays: Sequence[np.ndarray]) -> Tuple[np.ndarray, PackMeta]:
+    """Flatten *host* (numpy) arrays into one buffer via the native runtime
+    when built (``csrc/apex_tpu_C.cpp`` — the ``apex_C.flatten`` analog,
+    multithreaded memcpy); use for checkpoint staging and pre-``device_put``
+    coalescing, where :func:`pack`'s traced concatenate doesn't apply."""
+    from apex_tpu import _native
+    arrays = [np.asarray(a) for a in arrays]
+    flat = _native.flatten(arrays)
+    sizes = tuple(int(a.size) for a in arrays)
+    offsets = tuple(int(o) for o in np.cumsum((0,) + sizes[:-1]))
+    meta = PackMeta(shapes=tuple(a.shape for a in arrays), sizes=sizes,
+                    offsets=offsets, total=int(flat.size),
+                    padded=int(flat.size), dtype=flat.dtype)
+    return flat, meta
+
+
+def host_unpack(flat: np.ndarray, meta: PackMeta) -> List[np.ndarray]:
+    """Inverse of :func:`host_pack` (``apex_C.unflatten`` analog)."""
+    from apex_tpu import _native
+    return _native.unflatten(np.asarray(flat)[:meta.total], meta.shapes)
+
+
 def group_by_dtype(tensors: Sequence[jax.Array]):
     """Indices grouped by dtype — the analog of the reference's
     ``split_by_type`` bucketing (``apex/parallel/distributed.py:62-72``);

@@ -142,13 +142,32 @@ class DistributedDataParallel:
     With ``delay_allreduce`` semantics (grad accumulation every N steps),
     simply don't call ``reduce`` on non-boundary steps — the reference's
     ``Reducer`` manual-trigger pattern (``distributed.py:94-131``).
+
+    ``message_size`` is kept for the reference's bucketing knob
+    (``distributed.py:167``): XLA schedules collective overlap itself, but
+    :meth:`plan_buckets` exposes the same greedy assignment (native-backed)
+    for callers that reduce in explicit groups — e.g. ``Reducer`` cadences
+    that want one collective per ~message_size elements.
     """
 
     axis_name: str = "data"
     config: ReduceConfig = ReduceConfig()
+    message_size: int = 10_000_000
 
     def reduce(self, grads: Any) -> Any:
         return reduce_gradients(grads, self.axis_name, self.config)
+
+    def plan_buckets(self, grads: Any,
+                     triggers: Optional[Any] = None):
+        """Greedy in-order bucket ids for the leaves of ``grads``
+        (first-iteration bucket construction,
+        ``apex/parallel/distributed.py:339-362``; planning runs in the
+        native host library when built, ``csrc/apex_tpu_C.cpp``)."""
+        from apex_tpu import _native
+        leaves = jax.tree.leaves(grads)
+        numels = [int(l.size) for l in leaves]
+        trig = jax.tree.leaves(triggers) if triggers is not None else None
+        return _native.plan_buckets(numels, self.message_size, trig)
 
     @property
     def reduce_fn(self) -> Callable[[Any], Any]:

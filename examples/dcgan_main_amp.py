@@ -50,41 +50,53 @@ def main():
     gs, ds = a_g.init(gv["params"]), a_d.init(dv["params"])
     g_stats, d_stats = gv["batch_stats"], dv["batch_stats"]
 
-    def d_loss(dp, gp, g_stats, d_stats, z, real):
-        fake, g_mut = G.apply({"params": gp, "batch_stats": g_stats}, z,
-                              train=True, mutable=["batch_stats"])
-        d_real, d_mut = D.apply({"params": dp, "batch_stats": d_stats},
-                                real, train=True, mutable=["batch_stats"])
-        d_fake, d_mut = D.apply(
-            {"params": dp, "batch_stats": d_mut["batch_stats"]},
-            jax.lax.stop_gradient(fake), train=True,
-            mutable=["batch_stats"])
-        loss, _ = gan_losses(d_real, d_fake, d_fake)
-        return loss, (g_mut["batch_stats"], d_mut["batch_stats"])
+    # Stats are *closed over* (never passed through Amp.run's arg caster) so
+    # keep_batchnorm_fp32 holds: running buffers stay fp32 under O2/O3.
+    # Update cadence matches the reference DCGAN loop: per iteration G's BN
+    # stats update once (G's own forward in the G step; the fake used by D
+    # is a stats-frozen forward) while D's update three times (real + fake
+    # in the D step, fake again in the G step).
+    def make_d_loss(g_stats, d_stats):
+        def d_loss(dp, gp, z, real):
+            fake = G.apply({"params": gp, "batch_stats": g_stats}, z,
+                           train=True, mutable=["batch_stats"])[0]
+            d_real, d_mut = D.apply(
+                {"params": dp, "batch_stats": d_stats}, real,
+                train=True, mutable=["batch_stats"])
+            d_fake, d_mut = D.apply(
+                {"params": dp, "batch_stats": d_mut["batch_stats"]},
+                jax.lax.stop_gradient(fake), train=True,
+                mutable=["batch_stats"])
+            loss, _ = gan_losses(d_real, d_fake, d_fake)
+            return loss, d_mut["batch_stats"]
+        return d_loss
 
-    def g_loss(gp, dp, g_stats, d_stats, z):
-        fake, g_mut = G.apply({"params": gp, "batch_stats": g_stats}, z,
-                              train=True, mutable=["batch_stats"])
-        logits, d_mut = D.apply({"params": dp, "batch_stats": d_stats},
-                                fake, train=True, mutable=["batch_stats"])
-        _, loss = gan_losses(logits, logits, logits)
-        return loss, (g_mut["batch_stats"], d_mut["batch_stats"])
+    def make_g_loss(g_stats, d_stats):
+        def g_loss(gp, dp, z):
+            fake, g_mut = G.apply({"params": gp, "batch_stats": g_stats},
+                                  z, train=True, mutable=["batch_stats"])
+            logits, d_mut = D.apply({"params": dp, "batch_stats": d_stats},
+                                    fake, train=True,
+                                    mutable=["batch_stats"])
+            _, loss = gan_losses(logits, logits, logits)
+            return loss, (g_mut["batch_stats"], d_mut["batch_stats"])
+        return g_loss
 
     @jax.jit
     def train_step(gs, ds, g_stats, d_stats, z, real):
         # D step (loss_id 0 of the reference's shared-model two-scaler run)
         def scaled_d(dp):
-            l, stats = a_d.run(d_loss, dp, a_g.model_params(gs),
-                               g_stats, d_stats, z, real)
+            l, stats = a_d.run(make_d_loss(g_stats, d_stats), dp,
+                               a_g.model_params(gs), z, real)
             return a_d.scale_loss(l, ds), (l, stats)
-        d_grads, (dl, (g_stats_, d_stats_)) = \
+        d_grads, (dl, d_stats_) = \
             jax.grad(scaled_d, has_aux=True)(a_d.model_params(ds))
         ds, d_info = a_d.apply_gradients(ds, d_grads)
 
         # G step (loss_id 1)
         def scaled_g(gp):
-            l, stats = a_g.run(g_loss, gp, a_d.model_params(ds),
-                               g_stats_, d_stats_, z)
+            l, stats = a_g.run(make_g_loss(g_stats, d_stats_), gp,
+                               a_d.model_params(ds), z)
             return a_g.scale_loss(l, gs), (l, stats)
         g_grads, (gl, (g_stats_, d_stats_)) = \
             jax.grad(scaled_g, has_aux=True)(a_g.model_params(gs))
