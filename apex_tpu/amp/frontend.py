@@ -208,15 +208,23 @@ class Amp:
 
         sstate = state.scaler_states[loss_id]
         if stashed_grads is not None:
-            grads32, finite = self.scaler.unscale_with_stashed(
+            grads_unscaled, finite = self.scaler.unscale_with_stashed(
                 grads, stashed_grads, sstate)
         else:
-            grads32, finite = self.scaler.unscale(grads, sstate)
+            grads_unscaled, finite = self.scaler.unscale(grads, sstate)
+        # Grads land at each param's dtype: fp32 under master weights; model
+        # dtype without them (O3), so opt-state dtypes stay fixed across the
+        # cond branches (the reference's no-master-weights variants unscale
+        # in place at model dtype, ``_process_optimizer.py:165-239``).
+        grads_unscaled = jax.tree.map(
+            lambda g, p: g.astype(p.dtype) if hasattr(p, "dtype") else g,
+            grads_unscaled, state.master_params)
         new_sstate, overflow = self.scaler.update(sstate, finite)
 
         def do_step(operand):
             master, opt_state = operand
-            updates, new_opt_state = self.tx.update(grads32, opt_state, master)
+            updates, new_opt_state = self.tx.update(grads_unscaled, opt_state,
+                                                    master)
             new_master = optax.apply_updates(master, updates)
             return new_master, new_opt_state
 

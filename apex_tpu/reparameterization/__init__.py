@@ -1,0 +1,31 @@
+"""apex_tpu.reparameterization — weight normalization and the generic
+reparameterization transform.
+
+The reference subsystem (``apex/reparameterization/``) is broken in the
+snapshot (dead ``Fused_Weight_Norm`` import, SURVEY.md §0.3); this package
+provides the *working* capability with the same API names.  Like the
+reference, it is not imported by the package root — ``import
+apex_tpu.reparameterization`` explicitly (but unlike the reference, doing
+so succeeds).
+"""
+
+from apex_tpu.reparameterization.reparameterization import (
+    Reparameterization,
+    apply_reparameterization,
+    default_filter,
+    merge,
+    remove_reparameterization,
+    reparameterized_apply,
+)
+from apex_tpu.reparameterization.weight_norm import (
+    WeightNorm,
+    apply_weight_norm,
+    remove_weight_norm,
+)
+
+__all__ = [
+    "Reparameterization", "apply_reparameterization",
+    "remove_reparameterization", "merge", "reparameterized_apply",
+    "default_filter",
+    "WeightNorm", "apply_weight_norm", "remove_weight_norm",
+]

@@ -1,0 +1,145 @@
+"""Generic parameter reparameterization over pytrees.
+
+Port of ``apex/reparameterization/reparameterization.py`` — which in the
+reference snapshot is *dead code* (its ``weight_norm`` sibling imports the
+deleted ``Fused_Weight_Norm`` symbol, so ``import apex.reparameterization``
+raises — SURVEY.md §0.3).  This is the working TPU-native equivalent.
+
+The reference mechanism is an nn.Module forward-pre hook that recomputes a
+weight from auxiliary parameters before every forward
+(``reparameterization.py:57-145``).  The functional analog: the params
+pytree stores the auxiliary decomposition (e.g. ``kernel_g``/``kernel_v``),
+and :func:`merge` recomputes the original leaves *inside the traced step*,
+so autodiff differentiates through the decomposition exactly like the
+reference's hook — and XLA fuses the recompute into the consumers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+# Suffixes marking decomposed leaves (torch's weight_norm uses weight_g /
+# weight_v; we keep the convention relative to the original leaf name).
+G_SUFFIX = "_g"
+V_SUFFIX = "_v"
+
+
+class Reparameterization:
+    """Decompose/recompose one parameter array.
+
+    Subclasses implement :meth:`reparameterize` (array → dict of auxiliary
+    arrays) and :meth:`compute_weight` (auxiliary dict → array) — the same
+    pair the reference requires (``reparameterization.py:28-55``).
+    """
+
+    def reparameterize(self, name: str, weight: jax.Array) -> Dict[str, jax.Array]:
+        raise NotImplementedError
+
+    def compute_weight(self, name: str, aux: Dict[str, jax.Array]) -> jax.Array:
+        raise NotImplementedError
+
+
+def default_filter(name: str, leaf: Any) -> bool:
+    """Reference default: every parameter except 1-d vectors and scalars
+    (``apex/reparameterization/__init__.py`` apply_weight_norm docstring)."""
+    return (hasattr(leaf, "ndim") and leaf.ndim >= 2
+            and jnp.issubdtype(leaf.dtype, jnp.floating))
+
+
+def _is_leaf_dict(node) -> bool:
+    return isinstance(node, dict)
+
+
+def apply_reparameterization(
+    params: Any,
+    reparam: Reparameterization,
+    name: str = "",
+    filter_fn: Callable[[str, Any], bool] = default_filter,
+) -> Any:
+    """Replace selected leaves with their decomposition.
+
+    ``name``: restrict to leaves with this dict key ("" = all passing
+    ``filter_fn``, the reference's "no parameter provided" mode).  Returns a
+    new pytree of plain nested dicts where each selected ``k`` is replaced
+    by ``k+"_g"`` / ``k+"_v"`` entries.
+    """
+    def walk(node):
+        if not _is_leaf_dict(node):
+            return node
+        out = {}
+        for k, v in node.items():
+            if isinstance(v, dict):
+                out[k] = walk(v)
+            elif (name == "" or k == name) and filter_fn(k, v):
+                out.update(reparam.reparameterize(k, v))
+            else:
+                out[k] = v
+        return out
+
+    return walk(_to_plain_dict(params))
+
+
+def remove_reparameterization(params: Any,
+                              reparam: Reparameterization) -> Any:
+    """Merge decomposed leaves back into plain parameters — the reference's
+    ``remove`` (``reparameterization.py:127-137``), which bakes the current
+    effective weight back in."""
+    return merge(params, reparam)
+
+
+def merge(params: Any, reparam: Reparameterization) -> Any:
+    """Recompute every decomposed leaf (``k_g``/``k_v`` → ``k``).  Call
+    inside the traced step (or via :func:`reparameterized_apply`)."""
+    def walk(node):
+        if not _is_leaf_dict(node):
+            return node
+        out = {}
+        for k, v in node.items():
+            if isinstance(v, dict):
+                out[k] = walk(v)
+            elif k.endswith(G_SUFFIX):
+                base = k[: -len(G_SUFFIX)]
+                vkey = base + V_SUFFIX
+                if vkey in node:
+                    out[base] = reparam.compute_weight(
+                        base, {k: node[k], vkey: node[vkey]})
+            elif k.endswith(V_SUFFIX) and (k[: -len(V_SUFFIX)] + G_SUFFIX) in node:
+                pass  # consumed with its _g partner
+            else:
+                out[k] = v
+        return out
+
+    return walk(_to_plain_dict(params))
+
+
+def reparameterized_apply(apply_fn: Callable, reparam: Reparameterization,
+                          ) -> Callable:
+    """Wrap ``apply_fn(variables, ...)`` so it accepts decomposed params —
+    the functional analog of installing the forward-pre hook
+    (``reparameterization.py:139-145``).
+
+    Handles both a bare params tree and a flax ``{"params": ..., ...}``
+    variables dict.
+    """
+    def wrapped(variables, *args, **kwargs):
+        if isinstance(variables, dict) and "params" in variables:
+            merged = dict(variables)
+            merged["params"] = merge(variables["params"], reparam)
+        else:
+            merged = merge(variables, reparam)
+        return apply_fn(merged, *args, **kwargs)
+
+    return wrapped
+
+
+def _to_plain_dict(params: Any):
+    """Unfreeze flax FrozenDicts / mappings into plain nested dicts."""
+    if hasattr(params, "unfreeze"):
+        params = params.unfreeze()
+    if isinstance(params, dict):
+        return {k: _to_plain_dict(v) for k, v in params.items()}
+    return params

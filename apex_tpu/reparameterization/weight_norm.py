@@ -1,0 +1,82 @@
+"""Weight normalization: w = g · v / ‖v‖  (Salimans & Kingma, 1602.07868).
+
+Port of ``apex/reparameterization/weight_norm.py`` — broken in the
+reference snapshot (imports the deleted ``Fused_Weight_Norm`` CUDA backend,
+SURVEY.md §0.3); this is the working TPU-native version.  No hand-written
+kernel is needed: the norm + scale is a tiny reduction/broadcast pair that
+XLA fuses into the consuming matmul's prologue, which is exactly what the
+deleted fused CUDA kernel bought.
+
+Axis convention: ``dim`` is the axis *retained* (per-output-channel norms);
+the norm reduces over all other axes.  torch layouts put output channels at
+dim 0 (the reference default); flax kernels put them last, so the default
+here is ``dim=-1``.  ``dim=None`` computes one norm over the whole tensor
+(same as the reference's ``dim=None``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.reparameterization.reparameterization import (
+    G_SUFFIX,
+    V_SUFFIX,
+    Reparameterization,
+    apply_reparameterization,
+    default_filter,
+    merge,
+    remove_reparameterization,
+)
+
+
+def _norm_axes(ndim: int, dim: Optional[int]):
+    if dim is None:
+        return tuple(range(ndim)), None
+    dim = dim % ndim
+    return tuple(a for a in range(ndim) if a != dim), dim
+
+
+@dataclasses.dataclass(frozen=True)
+class WeightNorm(Reparameterization):
+    """g/v decomposition with norms in fp32 (the reference's fused kernel
+    accumulated in fp32 for half inputs — ``weight_norm.py:39-60``)."""
+
+    dim: Optional[int] = -1
+    eps: float = 0.0
+
+    def reparameterize(self, name: str, weight: jax.Array) -> Dict[str, jax.Array]:
+        axes, kept = _norm_axes(weight.ndim, self.dim)
+        w32 = weight.astype(jnp.float32)
+        g = jnp.sqrt(jnp.sum(jnp.square(w32), axis=axes, keepdims=True))
+        return {name + G_SUFFIX: g.astype(weight.dtype),
+                name + V_SUFFIX: weight}
+
+    def compute_weight(self, name: str, aux: Dict[str, jax.Array]) -> jax.Array:
+        g = aux[name + G_SUFFIX]
+        v = aux[name + V_SUFFIX]
+        axes, _ = _norm_axes(v.ndim, self.dim)
+        v32 = v.astype(jnp.float32)
+        norm = jnp.sqrt(jnp.sum(jnp.square(v32), axis=axes, keepdims=True)
+                        + self.eps)
+        w = g.astype(jnp.float32) * v32 / norm
+        return w.astype(v.dtype)
+
+
+def apply_weight_norm(params: Any, name: str = "", dim: Optional[int] = -1,
+                      filter_fn: Callable = default_filter) -> Any:
+    """Decompose selected leaves into ``*_g``/``*_v``
+    (``apex.reparameterization.apply_weight_norm``; ``name=""`` applies to
+    every ≥2-d float param).  Initialization preserves the effective weight:
+    ``merge(apply_weight_norm(p)) == p``."""
+    return apply_reparameterization(params, WeightNorm(dim=dim), name=name,
+                                    filter_fn=filter_fn)
+
+
+def remove_weight_norm(params: Any, dim: Optional[int] = -1) -> Any:
+    """Bake current effective weights back into plain parameters
+    (``apex.reparameterization.remove_weight_norm``)."""
+    return remove_reparameterization(params, WeightNorm(dim=dim))

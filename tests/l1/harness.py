@@ -1,0 +1,166 @@
+"""L1 conformance harness: train small workloads, produce loss digests.
+
+Port of ``tests/L1/common/main_amp.py`` + ``compare.py``: the reference
+trained the same workload twice — once with the CUDA extensions installed,
+once Python-only — and asserted per-iteration loss *bitwise equality*
+between the two installs.  Our two installs are the kernel paths
+(``APEX_TPU_KERNELS=pallas`` vs ``jnp``, SURVEY.md §7 "Bitwise L1
+conformance"); the digest is the per-iteration loss sequence plus its
+native fingerprint (``csrc/apex_tpu_C.cpp`` ``apex_fingerprint64`` — the
+analog of compare.py's stored digests).
+
+Determinism contract (the ``--deterministic`` flag): fixed PRNG keys, fixed
+synthetic data, single compiled path — two runs of the same config must
+produce identical fingerprints.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import flax.linen as nn
+
+from apex_tpu import amp
+from apex_tpu import _native
+from apex_tpu.layers import Conv, Dense
+from apex_tpu.models.mlp import MLP, cross_entropy_loss
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+
+@contextmanager
+def kernel_path(mode: str):
+    """Select the fused (pallas) or reference (jnp) kernel path — the
+    harness's with-ext / no-ext axis (``run_test.sh`` pip-reinstalled apex
+    both ways; we flip APEX_TPU_KERNELS)."""
+    old = os.environ.get("APEX_TPU_KERNELS")
+    os.environ["APEX_TPU_KERNELS"] = mode
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop("APEX_TPU_KERNELS", None)
+        else:
+            os.environ["APEX_TPU_KERNELS"] = old
+
+
+class ConvBNNet(nn.Module):
+    """Tiny conv net with BatchNorm — exercises keep_batchnorm_fp32."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = Conv(8, 3, name="conv1")(x)
+        x = SyncBatchNorm(name="bn1")(x, use_running_average=not train)
+        x = nn.relu(x)
+        x = x.reshape(x.shape[0], -1)
+        return Dense(self.num_classes, name="fc")(x)
+
+
+def digest_name(kernels: str, opt_level: str, loss_scale, keep_bn,
+                fused_adam: bool) -> str:
+    """Reference digest file naming:
+    ``<has_ext>_<opt_level>_<loss_scale>_<keep_bn>_<fused_adam>``."""
+    return f"{kernels}_{opt_level}_{loss_scale}_{keep_bn}_{fused_adam}"
+
+
+def run_workload(
+    opt_level: str = "O1",
+    loss_scale: Union[None, float, str] = None,
+    keep_batchnorm_fp32=None,
+    fused_adam: bool = False,
+    with_bn: bool = False,
+    steps: int = 6,
+    batch: int = 32,
+    seed: int = 0,
+    kernels: str = "auto",
+    inject_inf_at: Optional[int] = None,
+) -> Dict:
+    """Train a small workload deterministically; return its digest.
+
+    ``inject_inf_at``: plant an inf in the input at that iteration — the
+    fault-injection axis of the reference conformance suite
+    (``test_multiple_models_optimizers_losses.py:69-80``).
+    """
+    with kernel_path(kernels):
+        if with_bn:
+            model = ConvBNNet()
+            x0 = jnp.zeros((2, 8, 8, 3))
+            variables = model.init(jax.random.PRNGKey(seed), x0, train=True)
+            params = variables["params"]
+            batch_stats = variables["batch_stats"]
+        else:
+            model = MLP(features=(64, 64))
+            params = model.init(jax.random.PRNGKey(seed),
+                                jnp.zeros((1, 32)))["params"]
+            batch_stats = None
+
+        tx = (FusedAdam(lr=1e-2) if fused_adam
+              else optax.sgd(0.05, momentum=0.9))
+        a = amp.initialize(optimizer=tx, opt_level=opt_level,
+                           loss_scale=loss_scale,
+                           keep_batchnorm_fp32=keep_batchnorm_fp32,
+                           verbosity=0)
+        state = a.init(params)
+
+        if with_bn:
+            def make_loss(stats):
+                def loss_fn(p, xb, yb):
+                    logits, mut = model.apply(
+                        {"params": p, "batch_stats": stats}, xb,
+                        train=True, mutable=["batch_stats"])
+                    return (cross_entropy_loss(logits, yb),
+                            mut["batch_stats"])
+                return loss_fn
+
+            def step(state, stats, xb, yb):
+                inner = amp.make_train_step(a, make_loss(stats),
+                                            has_aux=True)
+                state, m = inner(state, xb, yb)
+                return state, m["aux"], m
+
+            step = jax.jit(step)
+        else:
+            inner = amp.make_train_step(
+                a, lambda p, xb, yb: cross_entropy_loss(
+                    model.apply({"params": p}, xb), yb))
+
+            def step(state, stats, xb, yb):
+                state, m = inner(state, xb, yb)
+                return state, stats, m
+
+            step = jax.jit(step)
+
+        rng = np.random.RandomState(seed)
+        if with_bn:
+            data_x = rng.randn(steps, batch, 8, 8, 3).astype(np.float32)
+        else:
+            data_x = rng.randn(steps, batch, 32).astype(np.float32)
+        data_y = rng.randint(0, 10, (steps, batch))
+
+        losses, scales, overflows = [], [], []
+        for i in range(steps):
+            xb = jnp.asarray(data_x[i])
+            if inject_inf_at is not None and i == inject_inf_at:
+                xb = xb.at[0].set(jnp.inf)
+            state, batch_stats, m = step(state, batch_stats, xb,
+                                         jnp.asarray(data_y[i]))
+            losses.append(float(m["loss"]))
+            scales.append(float(m["loss_scale"]))
+            overflows.append(bool(m["overflow"]))
+
+        loss_arr = np.asarray(losses, dtype=np.float64)
+        return {
+            "losses": losses,
+            "scales": scales,
+            "overflows": overflows,
+            "fingerprint": _native.fingerprint64(loss_arr),
+            "final_params": state.master_params,
+        }

@@ -1,0 +1,83 @@
+"""L1 conformance cross-product (``tests/L1/common/run_test.sh:1-150``).
+
+The reference asserted per-iteration loss bitwise equality between the
+CUDA-ext and Python-only installs over {O0–O3} × {default, 1.0, 128.0,
+dynamic loss scale} × keep_batchnorm variants.  Here the two installs are
+the pallas(interpret) and jnp kernel paths; equality is exact (same dtypes,
+same PRNG, SURVEY.md §7's redefined contract), and every config also gets
+a tolerance check against the O0 fp32 reference run.
+"""
+
+import numpy as np
+import pytest
+
+from tests.l1.harness import digest_name, run_workload
+
+OPT_LEVELS = ["O0", "O1", "O2", "O3"]
+LOSS_SCALES = [None, 1.0, 128.0, "dynamic"]
+
+
+@pytest.mark.parametrize("opt_level", OPT_LEVELS)
+@pytest.mark.parametrize("loss_scale", [None, 128.0])
+def test_fused_vs_reference_path_exact(opt_level, loss_scale):
+    """The ext-vs-no-ext bitwise axis: fused (pallas) and reference (jnp)
+    kernel paths must produce identical loss digests."""
+    ref = run_workload(opt_level=opt_level, loss_scale=loss_scale,
+                       kernels="jnp", fused_adam=True)
+    fused = run_workload(opt_level=opt_level, loss_scale=loss_scale,
+                         kernels="pallas", fused_adam=True)
+    assert ref["fingerprint"] == fused["fingerprint"], (
+        digest_name("jnp", opt_level, loss_scale, None, True),
+        ref["losses"], fused["losses"])
+
+
+@pytest.mark.parametrize("loss_scale", LOSS_SCALES)
+def test_deterministic_reruns(loss_scale):
+    """--deterministic contract: identical config → identical fingerprint."""
+    a = run_workload(opt_level="O1", loss_scale=loss_scale)
+    b = run_workload(opt_level="O1", loss_scale=loss_scale)
+    assert a["fingerprint"] == b["fingerprint"]
+
+
+@pytest.mark.parametrize("opt_level", ["O1", "O2", "O3"])
+def test_mixed_precision_tracks_fp32(opt_level):
+    """Every opt level's loss curve stays near the O0 fp32 reference
+    (compare.py's stored-baseline axis, tolerance-based per SURVEY §7)."""
+    ref = run_workload(opt_level="O0")
+    got = run_workload(opt_level=opt_level)
+    # bf16 compute: loose but meaningful tolerance; curves must co-descend
+    np.testing.assert_allclose(got["losses"], ref["losses"],
+                               rtol=0.1, atol=0.05)
+    assert got["losses"][-1] < got["losses"][0]
+
+
+@pytest.mark.parametrize("keep_bn", [True, False])
+@pytest.mark.parametrize("opt_level", ["O2", "O3"])
+def test_keep_batchnorm_cross_product(opt_level, keep_bn):
+    """BN workload across the keep_batchnorm_fp32 axis (run_test.sh's
+    third loop variable)."""
+    got = run_workload(opt_level=opt_level, keep_batchnorm_fp32=keep_bn,
+                       with_bn=True)
+    ref = run_workload(opt_level="O0", with_bn=True)
+    np.testing.assert_allclose(got["losses"], ref["losses"],
+                               rtol=0.15, atol=0.1)
+
+
+def test_overflow_injection_skips_and_recovers():
+    """Fault-injection axis: an inf at iteration 2 must trip the scaler
+    (skip + halve) under dynamic scaling, and training must recover."""
+    d = run_workload(opt_level="O2", loss_scale="dynamic", inject_inf_at=2,
+                     steps=6)
+    assert d["overflows"][2] is True
+    assert not any(d["overflows"][:2]) and not any(d["overflows"][3:])
+    # scale halved at the overflow step
+    assert d["scales"][2] == d["scales"][1] / 2
+    assert d["losses"][-1] < d["losses"][0]
+
+
+def test_static_scale_unchanged_by_overflow():
+    """Static loss scale: overflow skips the step but never rescales
+    (reference LossScaler with dynamic=False, scaler.py:46-51)."""
+    d = run_workload(opt_level="O2", loss_scale=128.0, inject_inf_at=2)
+    assert d["overflows"][2] is True
+    assert all(s == 128.0 for s in d["scales"])
