@@ -1,0 +1,137 @@
+"""Checkpoint / resume for amp training state.
+
+The reference persisted fp32 masters + scaler state only through the two
+FP16_Optimizer wrappers' ``state_dict`` ("option 2: save masters
+separately", ``apex/fp16_utils/fp16_optimizer.py:298-359``,
+``apex/optimizers/fp16_optimizer.py:211-274``) and had **no** amp-level
+checkpoint — the scaler states in ``_amp_state.loss_scalers`` were lost on
+restart (SURVEY.md §5.4).  This module closes that gap: the whole
+:class:`~apex_tpu.amp.frontend.AmpState` (fp32 masters, optimizer state,
+every loss scaler, step counter) plus arbitrary extras (e.g. BatchNorm
+running stats, epoch counters) round-trips through orbax.
+
+App-level pattern (the reference's epoch checkpointing,
+``examples/imagenet/main_amp.py:170-185,244-254``)::
+
+    mgr = CheckpointManager(dir, max_to_keep=3)
+    mgr.save(step, state, extras={"batch_stats": bs, "epoch": e})
+    state, extras = mgr.restore(state, extras=...)   # on resume
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from apex_tpu.amp.frontend import AmpState
+from apex_tpu.amp.scaler import LossScaleState
+
+
+def state_dict(state: AmpState, extras: Optional[Dict[str, Any]] = None
+               ) -> Dict[str, Any]:
+    """AmpState → plain nested dict (the ``amp.state_dict`` the reference
+    snapshot lacked).  Everything is converted to host numpy so the result
+    pickles / serializes with any backend."""
+    return {
+        "master_params": jax.tree.map(np.asarray, state.master_params),
+        "opt_state": jax.tree.map(np.asarray, state.opt_state),
+        "scaler_states": [
+            {"loss_scale": np.asarray(s.loss_scale),
+             "unskipped": np.asarray(s.unskipped)}
+            for s in state.scaler_states],
+        "step": np.asarray(state.step),
+        # Always present (possibly empty) so save/restore tree structures
+        # match whenever both sides pass the same extras template.
+        "extras": jax.tree.map(np.asarray, extras if extras else {}),
+    }
+
+
+def load_state_dict(template: AmpState, d: Dict[str, Any]
+                    ) -> Tuple[AmpState, Dict[str, Any]]:
+    """Rebuild an AmpState from :func:`state_dict` output.  ``template``
+    (e.g. a freshly ``Amp.init``-ed state) supplies the tree structure and
+    dtypes; saved leaves are matched structurally, so the optimizer and
+    model must be constructed identically — the same contract as the
+    reference's ``load_state_dict`` (``fp16_optimizer.py:330-359``)."""
+    def like(saved, ref):
+        return jax.tree.map(
+            lambda s, r: jax.numpy.asarray(s, dtype=r.dtype), saved, ref)
+
+    scalers = tuple(
+        LossScaleState(
+            loss_scale=jax.numpy.asarray(sd["loss_scale"],
+                                         dtype=ref.loss_scale.dtype),
+            unskipped=jax.numpy.asarray(sd["unskipped"],
+                                        dtype=ref.unskipped.dtype))
+        for sd, ref in zip(d["scaler_states"], template.scaler_states))
+    state = AmpState(
+        master_params=like(d["master_params"], template.master_params),
+        opt_state=like(d["opt_state"], template.opt_state),
+        scaler_states=scalers,
+        step=jax.numpy.asarray(d["step"], dtype=template.step.dtype),
+    )
+    return state, d.get("extras", {})
+
+
+class CheckpointManager:
+    """Orbax-backed epoch/step checkpointing with retention.
+
+    Persists the full amp training state; ``restore`` resumes the scaler
+    exactly (loss scale + unskipped counter), which the reference could
+    not do.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+
+    def save(self, step: int, state: AmpState,
+             extras: Optional[Dict[str, Any]] = None) -> None:
+        """Write asynchronously — the training loop is not blocked on disk
+        (call :meth:`wait` / :meth:`close` before exiting, as the imagenet
+        example does; ``restore`` waits automatically)."""
+        payload = state_dict(state, extras)
+        self._mgr.save(int(step),
+                       args=self._ocp.args.StandardSave(payload))
+
+    def wait(self) -> None:
+        """Block until any in-flight async save has committed."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+    def latest_step(self) -> Optional[int]:
+        self._mgr.wait_until_finished()
+        return self._mgr.latest_step()
+
+    def restore(self, template: AmpState,
+                step: Optional[int] = None,
+                extras: Optional[Dict[str, Any]] = None
+                ) -> Tuple[AmpState, Dict[str, Any]]:
+        """Restore the given (or latest) step.
+
+        ``extras`` must be a structure template matching what the
+        checkpoint was *saved* with (same keys/shapes; values are ignored)
+        — the same structural contract as ``load_state_dict``.  A save
+        without extras restores without them.
+        """
+        self._mgr.wait_until_finished()
+        if step is None:
+            step = self._mgr.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint found in {self._dir}")
+        target = state_dict(template, extras)
+        payload = self._mgr.restore(
+            int(step), args=self._ocp.args.StandardRestore(target))
+        return load_state_dict(template, payload)

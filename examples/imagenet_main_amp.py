@@ -53,6 +53,14 @@ def parse_args():
                    help="profile N steps then exit (reference --prof)")
     p.add_argument("--deterministic", action="store_true")
     p.add_argument("--print-freq", type=int, default=10)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="save a checkpoint every --checkpoint-freq steps "
+                        "(reference epoch checkpointing, "
+                        "main_amp.py:170-185)")
+    p.add_argument("--checkpoint-freq", type=int, default=50)
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest checkpoint in "
+                        "--checkpoint-dir (reference --resume)")
     return p.parse_args()
 
 
@@ -147,14 +155,28 @@ def main():
 
         step = jax.jit(step)
 
+    mgr = None
+    start_step = 0
+    if args.checkpoint_dir:
+        from apex_tpu.checkpoint import CheckpointManager
+        mgr = CheckpointManager(args.checkpoint_dir)
+        if args.resume and mgr.latest_step() is not None:
+            state, extras = mgr.restore(state,
+                                        extras={"batch_stats": batch_stats})
+            batch_stats = extras["batch_stats"]
+            start_step = mgr.latest_step() + 1
+            maybe_print(f"resumed from step {mgr.latest_step()}")
+
     global_batch = args.batch_size * n_dev
     steps = args.prof or args.steps
     batch_time, losses = AverageMeter(), AverageMeter()
     end = time.time()
-    for i in range(steps):
+    for i in range(start_step, steps):
         kx = jax.random.PRNGKey(seed + i + 1)
         x, y = synthetic_batch(kx, global_batch, args.image_size)
         state, batch_stats, loss, scale = step(state, batch_stats, x, y)
+        if mgr is not None and (i + 1) % args.checkpoint_freq == 0:
+            mgr.save(i, state, extras={"batch_stats": batch_stats})
         loss = float(loss)  # sync point, as in the reference's loss print
         batch_time.update(time.time() - end)
         end = time.time()
@@ -165,6 +187,8 @@ def main():
                 f"scale {float(scale):.0f}  "
                 f"{global_batch / batch_time.val:.0f} img/s "
                 f"({global_batch / max(batch_time.avg, 1e-9):.0f} avg)")
+    if mgr is not None:
+        mgr.wait()  # commit any in-flight async checkpoint
     maybe_print(f"Speed: {global_batch / max(batch_time.avg, 1e-9):.1f} "
                 "img/s total")
 

@@ -1,0 +1,29 @@
+"""Build integration (reference ``setup.py:29-103`` built five CUDA
+extension modules; here one C++ host-runtime library is compiled and the
+device kernels are Pallas, needing no build step).
+
+``pip install .`` / ``python setup.py build`` compiles
+``csrc/apex_tpu_C.cpp`` into ``apex_tpu/_native/libapex_tpu_C.so``.  The
+library also auto-builds on first import (``apex_tpu/_native/__init__.py``)
+and has a pure-numpy fallback, so a "Python-only install" — the reference
+build matrix's second axis — is simply an install without a toolchain.
+"""
+
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        try:
+            subprocess.run(["make", "-C", "csrc"], check=True)
+        except Exception as e:  # toolchain-less install: fallback path
+            print(f"apex_tpu: native build skipped ({e}); "
+                  "pure-numpy fallback will be used")
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildWithNative},
+      package_data={"apex_tpu._native": ["*.so"]})
