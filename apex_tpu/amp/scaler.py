@@ -100,11 +100,13 @@ class LossScaler:
         to inf is always seen, matching the fused kernel which checks the
         input values it reads (``multi_tensor_scale_kernel.cu:57-71``).
         """
-        inv = (1.0 / state.loss_scale).astype(jnp.float32)
-        finite = all_finite(grads)
-        unscaled = jax.tree.map(
-            lambda g: (g.astype(jnp.float32) * inv).astype(out_dtype), grads)
-        return unscaled, finite
+        with jax.named_scope("amp_unscale"):
+            inv = (1.0 / state.loss_scale).astype(jnp.float32)
+            finite = all_finite(grads)
+            unscaled = jax.tree.map(
+                lambda g: (g.astype(jnp.float32) * inv).astype(out_dtype),
+                grads)
+            return unscaled, finite
 
     def unscale_with_stashed(self, new_grads: Any, stashed: Any,
                              state: LossScaleState,

@@ -1,0 +1,14 @@
+"""apex_tpu.attention — sequence/context-parallel attention.
+
+Long-context support the reference lacks (SURVEY.md §5.7): ring attention
+(K/V rotation with online softmax) and Ulysses-style all-to-all head/
+sequence resharding, both exact and mesh-axis native.
+"""
+
+from apex_tpu.attention.ring import (
+    attention,
+    ring_attention,
+    ulysses_attention,
+)
+
+__all__ = ["attention", "ring_attention", "ulysses_attention"]

@@ -110,6 +110,7 @@ def reduce_gradients(grads: Any, axis_name: str,
     """
     world = lax.axis_size(axis_name)
 
+    @jax.named_scope("ddp_allreduce")
     def reduce_leaf(g):
         orig_dtype = g.dtype
         if config.allreduce_always_fp32:

@@ -141,7 +141,9 @@ class SyncBatchNorm(nn.Module):
             invstd = lax.rsqrt(var + self.epsilon)
             return batchnorm_forward(x, mean, invstd, weight, bias, ch_axis)
 
-        local_mean, local_var, local_count = welford_mean_var(x, reduce_axes)
+        with jax.named_scope("sync_bn_welford"):  # reference nvtx range
+            local_mean, local_var, local_count = welford_mean_var(
+                x, reduce_axes)
 
         # During init there is no bound mesh axis to reduce over; local stats
         # are fine (flax's BatchNorm does the same).

@@ -1,3 +1,13 @@
 from apex_tpu.utils.logging import maybe_print, set_verbosity, warn_or_err
+from apex_tpu.utils.profiling import (
+    annotate,
+    nvtx_range,
+    profiler_start,
+    profiler_stop,
+    range_pop,
+    range_push,
+)
 
-__all__ = ["maybe_print", "set_verbosity", "warn_or_err"]
+__all__ = ["maybe_print", "set_verbosity", "warn_or_err",
+           "nvtx_range", "range_push", "range_pop", "annotate",
+           "profiler_start", "profiler_stop"]

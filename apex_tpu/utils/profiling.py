@@ -1,0 +1,86 @@
+"""Tracing / profiling annotations (SURVEY.md §5.1).
+
+The reference sprinkled NVTX ranges at hot spots
+(``apex/parallel/sync_batchnorm.py:66,84,129``,
+``sync_batchnorm_kernel.py:11-47``) and drove nsight via
+``torch.cuda.cudart().cudaProfilerStart/Stop``
+(``tests/distributed/DDP/ddp_race_condition_test.py:44,66``) plus a
+``--prof`` early-exit loop in the imagenet example
+(``examples/imagenet/main_amp.py:63-64,311-334``).
+
+TPU equivalents:
+
+- :func:`nvtx_range` — ``jax.named_scope`` (names the HLO ops, visible in
+  XProf's trace viewer and HLO graphs) combined with
+  ``jax.profiler.TraceAnnotation`` (names the host-side section);
+- :func:`range_push` / :func:`range_pop` — the imperative NVTX API shape;
+- :func:`profiler_start` / :func:`profiler_stop` — capture an XProf trace
+  to a log directory (view with TensorBoard's profile plugin or
+  xprof);
+- :func:`annotate` — decorator form for step functions.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, List, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def nvtx_range(name: str):
+    """Named region covering both the traced computation (HLO metadata)
+    and host time (profiler TraceAnnotation)."""
+    with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+        yield
+
+
+_range_stack: List[contextlib.ExitStack] = []
+
+
+def range_push(name: str) -> None:
+    """Imperative begin (``torch.cuda.nvtx.range_push`` shape)."""
+    es = contextlib.ExitStack()
+    es.enter_context(nvtx_range(name))
+    _range_stack.append(es)
+
+
+def range_pop() -> None:
+    """Imperative end (``torch.cuda.nvtx.range_pop``)."""
+    if _range_stack:
+        _range_stack.pop().close()
+
+
+def annotate(name: Optional[str] = None) -> Callable:
+    """Decorator: run the function inside a named range."""
+    def deco(fn):
+        label = name or fn.__name__
+
+        def wrapped(*args, **kwargs):
+            with nvtx_range(label):
+                return fn(*args, **kwargs)
+
+        wrapped.__name__ = fn.__name__
+        wrapped.__doc__ = fn.__doc__
+        return wrapped
+    return deco
+
+
+_trace_active = False
+
+
+def profiler_start(logdir: str = "/tmp/apex_tpu_trace") -> None:
+    """Begin an XProf capture (``cudaProfilerStart`` analog)."""
+    global _trace_active
+    if not _trace_active:
+        jax.profiler.start_trace(logdir)
+        _trace_active = True
+
+
+def profiler_stop() -> None:
+    """End the capture and flush the trace (``cudaProfilerStop``)."""
+    global _trace_active
+    if _trace_active:
+        jax.profiler.stop_trace()
+        _trace_active = False

@@ -169,6 +169,12 @@ def main():
 
     global_batch = args.batch_size * n_dev
     steps = args.prof or args.steps
+    if args.prof:
+        # reference --prof: nvtx ranges + early exit (main_amp.py:63-64);
+        # here a full XProf capture of the profiled steps.
+        from apex_tpu.utils import profiler_start
+        profiler_start("/tmp/apex_tpu_trace")
+        maybe_print(f"profiling {steps} steps -> /tmp/apex_tpu_trace")
     batch_time, losses = AverageMeter(), AverageMeter()
     end = time.time()
     for i in range(start_step, steps):
@@ -187,6 +193,9 @@ def main():
                 f"scale {float(scale):.0f}  "
                 f"{global_batch / batch_time.val:.0f} img/s "
                 f"({global_batch / max(batch_time.avg, 1e-9):.0f} avg)")
+    if args.prof:
+        from apex_tpu.utils import profiler_stop
+        profiler_stop()
     if mgr is not None:
         mgr.wait()  # commit any in-flight async checkpoint
     maybe_print(f"Speed: {global_batch / max(batch_time.avg, 1e-9):.1f} "

@@ -1,0 +1,63 @@
+"""Tracing/profiling utility tests (SURVEY.md §5.1 port)."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils import (
+    annotate,
+    nvtx_range,
+    profiler_start,
+    profiler_stop,
+    range_pop,
+    range_push,
+)
+
+
+def test_nvtx_range_inside_jit():
+    @jax.jit
+    def f(x):
+        with nvtx_range("hot_section"):
+            return x * 2.0
+
+    assert float(f(jnp.float32(3.0))) == 6.0
+    # the named scope must land in the HLO metadata (kept in debug info)
+    hlo = jax.jit(_scoped).lower(jnp.float32(1.0)).as_text(debug_info=True)
+    assert "scoped_region" in hlo
+
+
+def _scoped(x):
+    with nvtx_range("scoped_region"):
+        return x + 1.0
+
+
+def test_range_push_pop_balanced():
+    range_push("outer")
+    range_push("inner")
+    range_pop()
+    range_pop()
+    range_pop()  # extra pop is a no-op, like nvtx
+
+
+def test_annotate_decorator():
+    @annotate()
+    def my_fn(x):
+        return x + 1
+
+    assert my_fn(1) == 2
+    assert my_fn.__name__ == "my_fn"
+
+
+def test_profiler_capture(tmp_path):
+    logdir = str(tmp_path / "trace")
+    profiler_start(logdir)
+    x = jnp.ones((8, 8))
+    jax.block_until_ready(jnp.dot(x, x))
+    profiler_stop()
+    # a trace event file must exist under the plugin directory
+    produced = glob.glob(os.path.join(logdir, "**", "*"), recursive=True)
+    assert any(os.path.isfile(p) for p in produced)
+    # idempotent stop
+    profiler_stop()
