@@ -85,6 +85,62 @@ def host_unpack(flat: np.ndarray, meta: PackMeta) -> List[np.ndarray]:
     return _native.unflatten(np.asarray(flat)[:meta.total], meta.shapes)
 
 
+class AlignedMeta(NamedTuple):
+    """Metadata for a chunk-aligned packed tensor list (each tensor padded to
+    a whole number of chunks, so every chunk belongs to exactly one tensor —
+    the flat-buffer analog of ``TensorListMetadata``'s block→(tensor, chunk)
+    table, ``csrc/multi_tensor_apply.cuh:17-24``)."""
+
+    shapes: Tuple[Tuple[int, ...], ...]
+    sizes: Tuple[int, ...]         # unpadded element counts
+    offsets: Tuple[int, ...]       # aligned start offsets in the flat buffer
+    chunk_size: int
+    padded: int                    # flat buffer length (multiple of chunk)
+    chunk_ids: Tuple[int, ...]     # chunk index -> tensor index
+    dtype: Any
+
+
+def pack_aligned(tensors: Sequence[jax.Array],
+                 chunk_size: int) -> Tuple[jax.Array, AlignedMeta]:
+    """Concatenate raveled tensors, padding EACH to a chunk multiple.
+
+    Wastes at most ``chunk_size - 1`` elements per tensor but guarantees
+    chunks never straddle tensors, so per-chunk scalar tables (weight decay,
+    trust ratios) in SMEM index cleanly by ``program_id`` — exactly how the
+    CUDA multi-tensor launcher resolves per-tensor arguments per block.
+    """
+    assert len(tensors) > 0
+    dtype = tensors[0].dtype
+    parts, shapes, sizes, offsets, chunk_ids = [], [], [], [], []
+    off = 0
+    for ti, t in enumerate(tensors):
+        size = int(np.prod(t.shape)) if t.shape else 1
+        n_chunks = -(-size // chunk_size)
+        padded = n_chunks * chunk_size
+        flat = jnp.ravel(t)
+        if padded != size:
+            flat = jnp.pad(flat, (0, padded - size))
+        parts.append(flat)
+        shapes.append(tuple(t.shape))
+        sizes.append(size)
+        offsets.append(off)
+        chunk_ids.extend([ti] * n_chunks)
+        off += padded
+    meta = AlignedMeta(shapes=tuple(shapes), sizes=tuple(sizes),
+                       offsets=tuple(offsets), chunk_size=chunk_size,
+                       padded=off, chunk_ids=tuple(chunk_ids), dtype=dtype)
+    return jnp.concatenate(parts), meta
+
+
+def unpack_aligned(flat: jax.Array, meta: AlignedMeta) -> List[jax.Array]:
+    """Slice an aligned flat buffer back into the original shapes."""
+    out = []
+    for shape, size, offset in zip(meta.shapes, meta.sizes, meta.offsets):
+        out.append(jax.lax.dynamic_slice_in_dim(flat, offset, size)
+                   .reshape(shape))
+    return out
+
+
 def group_by_dtype(tensors: Sequence[jax.Array]):
     """Indices grouped by dtype — the analog of the reference's
     ``split_by_type`` bucketing (``apex/parallel/distributed.py:62-72``);

@@ -1,0 +1,150 @@
+"""Pallas TPU kernels for the two-stage LAMB update.
+
+TPU-native equivalents of ``csrc/multi_tensor_lamb_stage_1.cu:17-121`` and
+``csrc/multi_tensor_lamb_stage_2.cu:18-92``.  The CUDA kernels resolve
+per-tensor arguments (weight decay, trust ratio) through the block→tensor
+table packed into kernel argument space; here the tensor list is packed
+chunk-*aligned* (:func:`apex_tpu.ops.packing.pack_aligned`) so each grid step
+covers exactly one tensor's chunk, and the per-chunk scalar table sits whole
+in SMEM, indexed by ``program_id`` — the direct analog of
+``TensorListMetadata``'s block→tensor map living in kernel argument space.
+
+Stage boundaries mirror the CUDA split: stage 1 is the gradient
+descale/clip → Adam moment update → ``update = m̂/(√v̂+ε) + decay·p`` pass;
+per-tensor ‖p‖/‖update‖ norms are reduced *between* the stages (the role of
+``multi_tensor_l2norm``'s per-tensor output feeding stage 2); stage 2 applies
+``p ← p − ratio·update`` with the per-tensor trust ratio (lr folded in, with
+the plain-lr fallback when either norm is zero).  All arithmetic is fp32.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops import on_tpu
+from apex_tpu.ops.pallas.multi_tensor_kernels import _LANES, _block, _view2d
+
+#: Base chunk size for aligned packing: one (8, 128) fp32 tile per grid step.
+LAMB_CHUNK = 8 * 128
+
+#: Upper bound on chunks per call — keeps the SMEM scalar tables (fp32 per
+#: chunk) around 128 KiB against the ~1 MiB SMEM budget; drivers grow the
+#: chunk size instead of the table (see fused_lamb._pallas_lamb_update).
+MAX_CHUNKS = 32768
+
+
+
+
+def _stage1_kernel(scalars_ref, decay_ref, g_ref, p_ref, m_ref, v_ref,
+                   u_ref, out_m_ref, out_v_ref):
+    beta1 = scalars_ref[0]
+    beta2 = scalars_ref[1]
+    eps = scalars_ref[2]
+    inv_scale = scalars_ref[3]   # 1 / clip_factor (grads arrive descaled)
+    bc1 = scalars_ref[4]         # 1 - beta1^step (or 1.0)
+    bc2 = scalars_ref[5]
+    # Per-tensor weight decay resolved through the chunk->tensor table in
+    # SMEM, indexed by grid position — the role of TensorListMetadata's
+    # block_to_tensor map (multi_tensor_apply.cuh:17-24).
+    decay = decay_ref[pl.program_id(0)]
+
+    g = g_ref[...].astype(jnp.float32) * inv_scale
+    p = p_ref[...].astype(jnp.float32)
+    m = beta1 * m_ref[...].astype(jnp.float32) + (1.0 - beta1) * g
+    v = beta2 * v_ref[...].astype(jnp.float32) + (1.0 - beta2) * g * g
+    update = (m / bc1) / (jnp.sqrt(v / bc2) + eps) + decay * p
+    u_ref[...] = update
+    out_m_ref[...] = m
+    out_v_ref[...] = v
+
+
+@functools.partial(jax.jit, static_argnames=("chunk_size",))
+def packed_lamb_stage1(g: jax.Array, p: jax.Array, m: jax.Array,
+                       v: jax.Array, per_chunk_decay: jax.Array, *,
+                       beta1, beta2, eps, inv_scale, bc1, bc2,
+                       chunk_size: int = LAMB_CHUNK):
+    """Stage 1 over chunk-aligned flat fp32 buffers.
+
+    ``per_chunk_decay``: fp32 ``(n_chunks,)`` — weight decay per chunk (i.e.
+    per tensor, via ``AlignedMeta.chunk_ids``).  Returns
+    ``(update, new_m, new_v)`` flat fp32 buffers.
+    """
+    n = g.shape[0]
+    n_chunks = n // chunk_size
+    br = _block(chunk_size)
+    scalars = jnp.stack([
+        jnp.asarray(beta1, jnp.float32),
+        jnp.asarray(beta2, jnp.float32),
+        jnp.asarray(eps, jnp.float32),
+        jnp.asarray(inv_scale, jnp.float32),
+        jnp.asarray(bc1, jnp.float32),
+        jnp.asarray(bc2, jnp.float32),
+    ])
+
+    def spec():
+        return pl.BlockSpec(br, lambda i: (i, 0))
+
+    u, new_m, new_v = pl.pallas_call(
+        _stage1_kernel,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            spec(), spec(), spec(), spec(),
+        ],
+        out_specs=[spec(), spec(), spec()],
+        out_shape=[jax.ShapeDtypeStruct((n // _LANES, _LANES), jnp.float32)
+                   for _ in range(3)],
+        interpret=not on_tpu(),
+    )(scalars, per_chunk_decay.astype(jnp.float32), _view2d(g), _view2d(p),
+      _view2d(m), _view2d(v))
+    return u.reshape(-1), new_m.reshape(-1), new_v.reshape(-1)
+
+
+def _stage2_kernel(ratio_ref, p_ref, u_ref, out_p_ref, *rest):
+    ratio = ratio_ref[pl.program_id(0)]  # lr·trust ratio for this tensor
+    p = p_ref[...].astype(jnp.float32) - ratio * u_ref[...]
+    out_p_ref[...] = p.astype(out_p_ref.dtype)
+    if rest:  # optional half-precision param writeback
+        rest[0][...] = p.astype(rest[0].dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk_size", "p_copy_dtype"))
+def packed_lamb_stage2(p: jax.Array, u: jax.Array,
+                       per_chunk_ratio: jax.Array, *,
+                       chunk_size: int = LAMB_CHUNK, p_copy_dtype=None):
+    """Stage 2: ``p ← p − ratio·update`` with the per-chunk (= per-tensor)
+    trust ratio in SMEM.  Returns ``new_p`` (or ``(new_p, p_copy)``)."""
+    n = p.shape[0]
+    n_chunks = n // chunk_size
+    br = _block(chunk_size)
+
+    def spec():
+        return pl.BlockSpec(br, lambda i: (i, 0))
+
+    out_shape = [jax.ShapeDtypeStruct((n // _LANES, _LANES), p.dtype)]
+    out_specs = [spec()]
+    if p_copy_dtype is not None:
+        out_shape.append(jax.ShapeDtypeStruct((n // _LANES, _LANES),
+                                              p_copy_dtype))
+        out_specs.append(spec())
+
+    outs = pl.pallas_call(
+        _stage2_kernel,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            spec(), spec(),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=not on_tpu(),
+    )(per_chunk_ratio.astype(jnp.float32), _view2d(p), _view2d(u))
+    if p_copy_dtype is None:
+        return outs[0].reshape(-1)
+    return outs[0].reshape(-1), outs[1].reshape(-1)

@@ -25,13 +25,71 @@ from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
+
+from apex_tpu.ops import use_pallas
 
 
 class FusedLAMBState(NamedTuple):
     step: jax.Array
     m: Any
     v: Any
+
+
+def _pallas_lamb_update(gs32, ps, ms, vs, *, lr, beta1, beta2, eps,
+                        weight_decay, max_grad_norm, bc1, bc2):
+    """Whole-tree two-stage LAMB via the Pallas kernels
+    (:mod:`apex_tpu.ops.pallas.lamb_kernels`).  Returns flat per-leaf lists
+    ``(deltas, new_m, new_v)``."""
+    from apex_tpu.ops.packing import pack_aligned, unpack_aligned
+    from apex_tpu.ops.pallas.lamb_kernels import (
+        LAMB_CHUNK, MAX_CHUNKS, packed_lamb_stage1, packed_lamb_stage2)
+
+    # Scale the chunk so the SMEM chunk->scalar tables stay bounded (~128 KiB
+    # against the ~1 MiB SMEM budget) regardless of model size.
+    total = sum(int(np.prod(p.shape)) if p.shape else 1 for p in ps)
+    chunk = LAMB_CHUNK * max(1, -(-total // (LAMB_CHUNK * MAX_CHUNKS)))
+
+    g_flat, meta = pack_aligned(gs32, chunk)
+    p_flat, _ = pack_aligned([p.astype(jnp.float32) for p in ps], chunk)
+    m_flat, _ = pack_aligned(ms, chunk)
+    v_flat, _ = pack_aligned(vs, chunk)
+    n_chunks = meta.padded // chunk
+    ids = jnp.asarray(np.array(meta.chunk_ids), jnp.int32)
+
+    # Stage-1 global-norm clip factor (already descaled grads; padding is
+    # zero so it never perturbs the norm).
+    gnorm = jnp.sqrt(jnp.sum(jnp.square(g_flat)))
+    if max_grad_norm and max_grad_norm > 0:
+        clip = jnp.maximum(gnorm / max_grad_norm, 1.0)
+    else:
+        clip = jnp.asarray(1.0, jnp.float32)
+
+    decay = jnp.full((n_chunks,), weight_decay, jnp.float32)
+    u_flat, new_m_flat, new_v_flat = packed_lamb_stage1(
+        g_flat, p_flat, m_flat, v_flat, decay,
+        beta1=beta1, beta2=beta2, eps=eps, inv_scale=1.0 / clip,
+        bc1=bc1, bc2=bc2, chunk_size=chunk)
+
+    # Per-tensor ‖p‖ / ‖update‖ between the stages: per-chunk partial sums
+    # reduced by tensor id (the per-tensor output of multi_tensor_l2norm
+    # feeding lamb stage 2 in the reference).
+    n_tensors = len(meta.shapes)
+    chunk_p = jnp.square(p_flat.reshape(n_chunks, chunk)).sum(axis=1)
+    chunk_u = jnp.square(u_flat.reshape(n_chunks, chunk)).sum(axis=1)
+    p_norm = jnp.sqrt(jnp.zeros((n_tensors,), jnp.float32).at[ids].add(chunk_p))
+    u_norm = jnp.sqrt(jnp.zeros((n_tensors,), jnp.float32).at[ids].add(chunk_u))
+    ratio_t = jnp.where((p_norm > 0) & (u_norm > 0),
+                        p_norm / jnp.maximum(u_norm, 1e-38), 1.0)
+    chunk_ratio = lr * ratio_t[ids]
+
+    new_p_flat = packed_lamb_stage2(p_flat, u_flat, chunk_ratio,
+                                    chunk_size=chunk)
+    deltas = unpack_aligned(new_p_flat - p_flat, meta)
+    return (deltas,
+            unpack_aligned(new_m_flat, meta),
+            unpack_aligned(new_v_flat, meta))
 
 
 def fused_lamb(learning_rate=1e-3, beta1: float = 0.9, beta2: float = 0.999,
@@ -64,6 +122,25 @@ def fused_lamb(learning_rate=1e-3, beta1: float = 0.9, beta2: float = 0.999,
 
         gs32 = [g.astype(jnp.float32) / jnp.asarray(scale, jnp.float32)
                 for g in gs]
+
+        if bias_correction:
+            bc1_ = 1.0 - jnp.power(beta1, step.astype(jnp.float32))
+            bc2_ = 1.0 - jnp.power(beta2, step.astype(jnp.float32))
+        else:
+            bc1_ = bc2_ = jnp.asarray(1.0, jnp.float32)
+
+        if use_pallas() and gs32:
+            deltas, new_ms, new_vs = _pallas_lamb_update(
+                gs32, ps, ms, vs, lr=lr, beta1=beta1, beta2=beta2, eps=eps,
+                weight_decay=weight_decay, max_grad_norm=max_grad_norm,
+                bc1=bc1_, bc2=bc2_)
+            updates = [d.astype(p.dtype) for d, p in zip(deltas, ps)]
+            return (jax.tree.unflatten(treedef, updates),
+                    FusedLAMBState(
+                        step=step,
+                        m=jax.tree.unflatten(treedef, new_ms),
+                        v=jax.tree.unflatten(treedef, new_vs)))
+
         # Stage-1 global-norm clip factor (lamb_stage_1.cu clipped_global_norm).
         gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in gs32))
         if max_grad_norm and max_grad_norm > 0:
@@ -71,11 +148,7 @@ def fused_lamb(learning_rate=1e-3, beta1: float = 0.9, beta2: float = 0.999,
         else:
             clip = jnp.asarray(1.0, jnp.float32)
 
-        if bias_correction:
-            bc1 = 1.0 - jnp.power(beta1, step.astype(jnp.float32))
-            bc2 = 1.0 - jnp.power(beta2, step.astype(jnp.float32))
-        else:
-            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+        bc1, bc2 = bc1_, bc2_
 
         updates, new_m, new_v = [], [], []
         for p, m, v, g in zip(ps, ms, vs, gs32):
