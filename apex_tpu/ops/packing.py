@@ -132,13 +132,26 @@ def pack_aligned(tensors: Sequence[jax.Array],
     return jnp.concatenate(parts), meta
 
 
-def unpack_aligned(flat: jax.Array, meta: AlignedMeta) -> List[jax.Array]:
-    """Slice an aligned flat buffer back into the original shapes."""
-    out = []
-    for shape, size, offset in zip(meta.shapes, meta.sizes, meta.offsets):
-        out.append(jax.lax.dynamic_slice_in_dim(flat, offset, size)
-                   .reshape(shape))
-    return out
+def pack_into(tensors: Sequence[jax.Array], meta: AlignedMeta) -> jax.Array:
+    """Pack a tensor list whose layout matches a precomputed
+    :class:`AlignedMeta` (same shapes, same chunk size) — skips rebuilding
+    the chunk table when several same-shaped lists share one layout, as the
+    LAMB driver's g/p/m/v quadruple does."""
+    parts = []
+    for t, size, off, next_off in zip(
+            tensors, meta.sizes, meta.offsets,
+            meta.offsets[1:] + (meta.padded,)):
+        flat = jnp.ravel(t)
+        padded = next_off - off
+        if padded != size:
+            flat = jnp.pad(flat, (0, padded - size))
+        parts.append(flat)
+    return jnp.concatenate(parts)
+
+
+# Aligned buffers unpack with the same slice-and-reshape as contiguous ones
+# (AlignedMeta shares the shapes/sizes/offsets prefix with PackMeta).
+unpack_aligned = unpack
 
 
 def group_by_dtype(tensors: Sequence[jax.Array]):

@@ -37,6 +37,13 @@ LAMB_CHUNK = 8 * 128
 #: chunk size instead of the table (see fused_lamb._pallas_lamb_update).
 MAX_CHUNKS = 32768
 
+#: Upper bound on the grown chunk size: stage 1 streams 7 fp32 buffers per
+#: grid step, so 64 Ki elements (256 KiB each) stays ~3.5 MiB double-buffered
+#: against the ~16 MiB VMEM budget.  MAX_CHUNKS × LAMB_CHUNK_MAX ≈ 2.1 B
+#: params is the Pallas path's capacity; beyond it drivers fall back to the
+#: jnp path rather than fail Mosaic compilation.
+LAMB_CHUNK_MAX = 64 * 1024
+
 
 
 

@@ -37,34 +37,37 @@ class FusedLAMBState(NamedTuple):
     v: Any
 
 
+def _within_pallas_capacity(ps) -> bool:
+    """True when the whole tree fits the Pallas path's chunk-table budget
+    (MAX_CHUNKS chunks of at most LAMB_CHUNK_MAX elements, ~2.1 B params);
+    larger trees take the jnp path instead of failing Mosaic compilation."""
+    from apex_tpu.ops.pallas.lamb_kernels import LAMB_CHUNK_MAX, MAX_CHUNKS
+    total = sum(int(np.prod(p.shape)) if p.shape else 1 for p in ps)
+    return total <= MAX_CHUNKS * LAMB_CHUNK_MAX
+
+
 def _pallas_lamb_update(gs32, ps, ms, vs, *, lr, beta1, beta2, eps,
-                        weight_decay, max_grad_norm, bc1, bc2):
+                        weight_decay, clip, bc1, bc2):
     """Whole-tree two-stage LAMB via the Pallas kernels
     (:mod:`apex_tpu.ops.pallas.lamb_kernels`).  Returns flat per-leaf lists
     ``(deltas, new_m, new_v)``."""
-    from apex_tpu.ops.packing import pack_aligned, unpack_aligned
+    from apex_tpu.ops.packing import pack_aligned, pack_into, unpack_aligned
     from apex_tpu.ops.pallas.lamb_kernels import (
         LAMB_CHUNK, MAX_CHUNKS, packed_lamb_stage1, packed_lamb_stage2)
 
     # Scale the chunk so the SMEM chunk->scalar tables stay bounded (~128 KiB
-    # against the ~1 MiB SMEM budget) regardless of model size.
+    # against the ~1 MiB SMEM budget) regardless of model size.  Callers
+    # guarantee total <= MAX_CHUNKS * LAMB_CHUNK_MAX so the grown chunk
+    # stays within the VMEM budget (see _pallas_capacity).
     total = sum(int(np.prod(p.shape)) if p.shape else 1 for p in ps)
     chunk = LAMB_CHUNK * max(1, -(-total // (LAMB_CHUNK * MAX_CHUNKS)))
 
     g_flat, meta = pack_aligned(gs32, chunk)
-    p_flat, _ = pack_aligned([p.astype(jnp.float32) for p in ps], chunk)
-    m_flat, _ = pack_aligned(ms, chunk)
-    v_flat, _ = pack_aligned(vs, chunk)
+    p_flat = pack_into([p.astype(jnp.float32) for p in ps], meta)
+    m_flat = pack_into(ms, meta)
+    v_flat = pack_into(vs, meta)
     n_chunks = meta.padded // chunk
     ids = jnp.asarray(np.array(meta.chunk_ids), jnp.int32)
-
-    # Stage-1 global-norm clip factor (already descaled grads; padding is
-    # zero so it never perturbs the norm).
-    gnorm = jnp.sqrt(jnp.sum(jnp.square(g_flat)))
-    if max_grad_norm and max_grad_norm > 0:
-        clip = jnp.maximum(gnorm / max_grad_norm, 1.0)
-    else:
-        clip = jnp.asarray(1.0, jnp.float32)
 
     decay = jnp.full((n_chunks,), weight_decay, jnp.float32)
     u_flat, new_m_flat, new_v_flat = packed_lamb_stage1(
@@ -129,24 +132,25 @@ def fused_lamb(learning_rate=1e-3, beta1: float = 0.9, beta2: float = 0.999,
         else:
             bc1_ = bc2_ = jnp.asarray(1.0, jnp.float32)
 
-        if use_pallas() and gs32:
+        # Stage-1 global-norm clip factor (lamb_stage_1.cu
+        # clipped_global_norm); shared by both execution paths (aligned-pack
+        # padding is zero, so per-leaf and flat-buffer norms agree).
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in gs32))
+        if max_grad_norm and max_grad_norm > 0:
+            clip = jnp.maximum(gnorm / max_grad_norm, 1.0)
+        else:
+            clip = jnp.asarray(1.0, jnp.float32)
+
+        if use_pallas() and gs32 and _within_pallas_capacity(ps):
             deltas, new_ms, new_vs = _pallas_lamb_update(
                 gs32, ps, ms, vs, lr=lr, beta1=beta1, beta2=beta2, eps=eps,
-                weight_decay=weight_decay, max_grad_norm=max_grad_norm,
-                bc1=bc1_, bc2=bc2_)
+                weight_decay=weight_decay, clip=clip, bc1=bc1_, bc2=bc2_)
             updates = [d.astype(p.dtype) for d, p in zip(deltas, ps)]
             return (jax.tree.unflatten(treedef, updates),
                     FusedLAMBState(
                         step=step,
                         m=jax.tree.unflatten(treedef, new_ms),
                         v=jax.tree.unflatten(treedef, new_vs)))
-
-        # Stage-1 global-norm clip factor (lamb_stage_1.cu clipped_global_norm).
-        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in gs32))
-        if max_grad_norm and max_grad_norm > 0:
-            clip = jnp.maximum(gnorm / max_grad_norm, 1.0)
-        else:
-            clip = jnp.asarray(1.0, jnp.float32)
 
         bc1, bc2 = bc1_, bc2_
 
