@@ -15,6 +15,13 @@ from apex_tpu.amp.frontend import (
     initialize,
     make_train_step,
 )
+from apex_tpu.amp.handle import (
+    AmpHandle,
+    NoOpHandle,
+    active_amp,
+    init,
+    scale_loss,
+)
 from apex_tpu.amp.ops import (
     banned_function,
     cast_context,
@@ -31,6 +38,7 @@ from apex_tpu.amp.scaler import LossScaler, LossScaleState, all_finite
 
 __all__ = [
     "Amp", "AmpState", "initialize", "make_train_step",
+    "init", "scale_loss", "active_amp", "AmpHandle", "NoOpHandle",
     "default_keep_fp32_filter",
     "Properties", "O0", "O1", "O2", "O3", "opt_levels", "resolve", "DYNAMIC",
     "LossScaler", "LossScaleState", "all_finite",

@@ -285,9 +285,14 @@ def initialize(
     if verbosity > 0:
         from apex_tpu.utils.logging import maybe_print
         maybe_print(f"apex_tpu.amp configured: {props}")
-    return Amp(properties=props, scaler=scaler, tx=optimizer,
-               apply_fn=apply_fn, num_losses=num_losses,
-               keep_fp32_filter=keep_fp32_filter)
+    amp = Amp(properties=props, scaler=scaler, tx=optimizer,
+              apply_fn=apply_fn, num_losses=num_losses,
+              keep_fp32_filter=keep_fp32_filter)
+    # Record for module-level amp.scale_loss (the reference's _amp_state
+    # global, apex/amp/_amp_state.py).
+    from apex_tpu.amp import handle as handle_lib
+    handle_lib._set_active_amp(amp)
+    return amp
 
 
 def make_train_step(

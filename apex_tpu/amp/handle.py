@@ -1,0 +1,168 @@
+"""Module-level ``scale_loss`` / legacy ``init`` handle API.
+
+Parity surface for the reference's two amp entry styles:
+
+- the **modern** ``amp.initialize`` + ``with amp.scale_loss(...)`` flow
+  (``apex/amp/handle.py:15-154``).  JAX has no imperative backward to wrap a
+  context manager around, so ``scale_loss`` here is the *functional* analog:
+  it returns the scaled loss to differentiate, and the exit-time work of the
+  reference's context manager (unscale, overflow check, scaler update,
+  conditional skip) lives in :meth:`apex_tpu.amp.Amp.apply_gradients` /
+  :func:`apex_tpu.amp.make_train_step`, compiled into the step.
+- the **legacy** ``handle = amp.init(...)`` / ``handle.wrap_optimizer`` API
+  (``apex/amp/amp.py:68-177`` init, ``handle.py:166-277`` AmpHandle /
+  NoOpHandle, ``opt.py:9-103`` OptimWrapper — "old API, kept for tests").
+  ``init`` activates the O1 op-cast policy process-wide (the analog of
+  monkey-patching torch) and hands back a handle whose ``wrap_optimizer``
+  builds a bound :class:`~apex_tpu.amp.frontend.Amp` — the OptimWrapper
+  equivalent.
+
+``initialize`` records the most recent :class:`Amp` so module-level
+``scale_loss`` can resolve a scaler without threading the object through
+user code — the role of the reference's global ``_amp_state``
+(``apex/amp/_amp_state.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import ops as amp_ops
+from apex_tpu.amp import policy as policy_lib
+from apex_tpu.amp.frontend import Amp, AmpState
+from apex_tpu.amp.scaler import LossScaler
+
+_active_amp: Optional[Amp] = None
+
+
+def _set_active_amp(a: Optional[Amp]) -> None:
+    global _active_amp
+    _active_amp = a
+
+
+def active_amp() -> Optional[Amp]:
+    """The :class:`Amp` from the most recent ``initialize`` call, if any."""
+    return _active_amp
+
+
+def scale_loss(loss: jax.Array, state: AmpState, loss_id: int = 0,
+               amp: Optional[Amp] = None) -> jax.Array:
+    """``loss * loss_scale`` for scaler ``loss_id`` (reference
+    ``amp.scale_loss`` enter, ``handle.py:96,116``).
+
+    Functional analog of the reference context manager: differentiate the
+    returned value; the unscale / overflow / scaler-update exit work is in
+    ``Amp.apply_gradients``.  ``amp`` defaults to the most recently
+    ``initialize``\\ d one (the reference's ``_amp_state`` global).
+    """
+    a = amp if amp is not None else _active_amp
+    if a is None:
+        raise RuntimeError(
+            "amp.scale_loss called before amp.initialize (reference "
+            "handle.py:78-86 raises the same way)")
+    return a.scale_loss(loss, state, loss_id=loss_id)
+
+
+class AmpHandle:
+    """Legacy handle (reference ``apex/amp/handle.py:166-248``).
+
+    Construction activates the op-cast policy process-wide until
+    :meth:`_deactivate` — the declarative analog of ``amp.init`` patching the
+    torch namespace.  The reference handle's per-iteration cast cache has no
+    analog: XLA CSE deduplicates repeated casts inside a trace, so
+    ``_clear_cache`` is a no-op kept for API compatibility.
+    """
+
+    def __init__(self, properties: policy_lib.Properties,
+                 verbose: bool = False):
+        self._properties = properties
+        self._verbose = verbose
+        self._all_wrappers = []
+        self._ctx = None
+        if properties.enabled and properties.cast_ops:
+            self._ctx = amp_ops.cast_context(properties)
+            self._ctx.__enter__()
+
+    @property
+    def is_active(self) -> bool:
+        return self._properties.enabled
+
+    @property
+    def has_cache(self) -> bool:
+        return False
+
+    def wrap_optimizer(self, optimizer, num_loss: int = 1) -> Amp:
+        """Bind an optax transformation (reference ``wrap_optimizer`` →
+        ``OptimWrapper``, ``opt.py:9-103``): returns an :class:`Amp` whose
+        ``init`` / ``apply_gradients`` carry the loss-scaling state."""
+        amp = Amp(properties=self._properties,
+                  scaler=LossScaler(loss_scale=self._properties.loss_scale),
+                  tx=optimizer, num_losses=num_loss)
+        self._all_wrappers.append(amp)
+        return amp
+
+    def scale_loss(self, loss: jax.Array, state: AmpState,
+                   loss_id: int = 0) -> jax.Array:
+        if not self.is_active:
+            return loss
+        if not self._all_wrappers:
+            raise RuntimeError("wrap_optimizer before scale_loss "
+                               "(legacy-flow ordering, opt.py:16-20)")
+        return self._all_wrappers[-1].scale_loss(loss, state,
+                                                 loss_id=loss_id)
+
+    def _clear_cache(self) -> None:
+        pass  # XLA CSE replaces the eager cast cache (utils.py:87-119)
+
+    def _deactivate(self) -> None:
+        """Undo global activation (reference ``AmpHandle._deactivate``,
+        ``handle.py:225-241``): pops the cast policy and any ``register_*``
+        namespace patches."""
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+        amp_ops.deactivate_registrations()
+
+
+class NoOpHandle:
+    """Disabled-amp handle (reference ``handle.py:250-277``)."""
+
+    @property
+    def is_active(self) -> bool:
+        return False
+
+    @property
+    def has_cache(self) -> bool:
+        return False
+
+    def wrap_optimizer(self, optimizer, num_loss: int = 1) -> Amp:
+        props = policy_lib.resolve(opt_level="O0", enabled=False)
+        return Amp(properties=props, scaler=LossScaler(loss_scale=1.0),
+                   tx=optimizer, num_losses=num_loss)
+
+    def scale_loss(self, loss, state, loss_id: int = 0):
+        return loss
+
+    def _clear_cache(self) -> None:
+        pass
+
+    def _deactivate(self) -> None:
+        pass
+
+
+def init(enabled: bool = True, opt_level: str = "O1",
+         half_dtype=jnp.bfloat16, loss_scale="dynamic",
+         enable_caching: bool = True, verbose: bool = False):
+    """Legacy global-activation entry point (reference ``amp.init``,
+    ``apex/amp/amp.py:68-177``): turn on the op-cast policy and return a
+    handle.  ``enable_caching`` is accepted for signature parity (see
+    :meth:`AmpHandle._clear_cache`).  Prefer :func:`apex_tpu.amp.initialize`.
+    """
+    if not enabled:
+        return NoOpHandle()
+    props = policy_lib.resolve(opt_level=opt_level, enabled=True,
+                               half_dtype=half_dtype, loss_scale=loss_scale)
+    return AmpHandle(props, verbose=verbose)

@@ -7,7 +7,9 @@ Port of ``apex/fp16_utils/__init__.py:1-16`` (11 utility functions +
 from apex_tpu.fp16_utils.fp16_optimizer import FP16Optimizer, FP16OptimizerState
 from apex_tpu.fp16_utils.fp16util import (
     BN_convert_float,
+    FP16Model,
     clip_grad_norm,
+    convert_module,
     convert_network,
     master_params_to_model_params,
     model_grads_to_master_grads,
@@ -24,7 +26,8 @@ network_to_half = tree_to_half
 
 __all__ = [
     "FP16Optimizer", "FP16OptimizerState",
-    "BN_convert_float", "clip_grad_norm", "convert_network",
+    "BN_convert_float", "FP16Model", "clip_grad_norm", "convert_module",
+    "convert_network",
     "master_params_to_model_params", "model_grads_to_master_grads",
     "prep_param_lists", "to_python_float", "tree_to_float", "tree_to_half",
     "tofp16", "network_to_half",

@@ -60,6 +60,46 @@ def BN_convert_float(params: Any,
     return jax.tree_util.tree_map_with_path(cast, params)
 
 
+def convert_module(params: Any, dtype) -> Any:
+    """Cast one module's param subtree to ``dtype`` unconditionally
+    (reference ``convert_module``, ``fp16util.py:44-57`` — the per-module
+    worker ``convert_network`` drives; batchnorm exemption is the *caller's*
+    recursion decision there, and :func:`convert_network`'s filter here)."""
+    return tree_to_half(params, dtype)
+
+
+class FP16Model:
+    """Half-precision wrapper around an apply function (reference
+    ``FP16Model``, ``fp16util.py:73-84``: ``network_to_half`` the module,
+    cast inputs to half in ``forward``).
+
+    >>> m = FP16Model(model.apply)
+    >>> half_params = m.convert(params)      # network_to_half
+    >>> y = m(half_params, x)                # inputs cast to half
+    """
+
+    def __init__(self, apply_fn: Callable, half_dtype=jnp.bfloat16):
+        self.apply_fn = apply_fn
+        self.half_dtype = half_dtype
+
+    def convert(self, params: Any) -> Any:
+        return tree_to_half(params, self.half_dtype)
+
+    def __call__(self, params: Any, *args, **kwargs):
+        import numpy as np
+
+        def cast(x):
+            # Only array inputs are tensor data; Python-scalar kwargs are
+            # hyperparameters and must stay static (the reference casts only
+            # the input tensor, fp16util.py:83).
+            if isinstance(x, (jax.Array, np.ndarray)) and jnp.issubdtype(
+                    jnp.asarray(x).dtype, jnp.floating):
+                return jnp.asarray(x).astype(self.half_dtype)
+            return x
+        args, kwargs = jax.tree.map(cast, (args, kwargs))
+        return self.apply_fn(params, *args, **kwargs)
+
+
 def prep_param_lists(params: Any, flat_master: bool = False
                      ) -> Tuple[Any, Any]:
     """Build (model_params, master_params) (reference ``prep_param_lists``,

@@ -33,8 +33,14 @@ from apex_tpu.parallel.mesh import (
 from apex_tpu.parallel.sync_batchnorm import (
     BatchNorm,
     SyncBatchNorm,
+    batchnorm_backward,
+    batchnorm_backward_c_last,
     batchnorm_forward,
+    batchnorm_forward_c_last,
+    reduce_bn,
+    reduce_bn_c_last,
     welford_mean_var,
+    welford_mean_var_c_last,
     welford_parallel,
 )
 
@@ -45,6 +51,9 @@ __all__ = [
     "SyncBatchNorm", "BatchNorm", "convert_syncbn_model",
     "create_syncbn_process_group",
     "welford_mean_var", "welford_parallel", "batchnorm_forward",
+    "reduce_bn", "batchnorm_backward", "welford_mean_var_c_last",
+    "batchnorm_forward_c_last", "reduce_bn_c_last",
+    "batchnorm_backward_c_last",
     "LARC", "larc",
     "mesh", "multiproc", "make_mesh", "data_parallel_mesh", "batch_sharding",
     "replicated_sharding", "world_size", "DATA_AXIS",

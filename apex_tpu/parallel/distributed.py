@@ -84,6 +84,26 @@ class ReduceConfig:
     compression: Optional[str] = None  # None | "sign"
 
 
+def _fold_reduce_config(self) -> None:
+    """Shared constructor logic for the wrappers that accept the reference
+    knob spellings: fold them into ``config`` when none is given, reject
+    conflicting specifications."""
+    knobs = dict(gradient_average=self.gradient_average,
+                 gradient_predivide_factor=self.gradient_predivide_factor,
+                 allreduce_always_fp32=self.allreduce_always_fp32,
+                 compression=self.compression)
+    if self.config is None:
+        object.__setattr__(self, "config", ReduceConfig(**knobs))
+        return
+    defaults = ReduceConfig()
+    changed = {k: v for k, v in knobs.items()
+               if v != getattr(defaults, k)}
+    if changed:
+        raise ValueError(
+            f"pass the reduction knobs either via config= or directly, "
+            f"not both (got config={self.config} and {changed})")
+
+
 def pvary_params(params: Any, axis_name: str) -> Any:
     """Mark replicated params as device-varying so gradients materialize
     *per-rank* instead of being auto-``psum``'d by shard_map's autodiff.
@@ -152,8 +172,17 @@ class DistributedDataParallel:
     """
 
     axis_name: str = "data"
-    config: ReduceConfig = ReduceConfig()
+    config: Optional[ReduceConfig] = None
     message_size: int = 10_000_000
+    # Reference-constructor spellings (distributed.py:167-177); folded into
+    # ``config`` when one isn't given explicitly.
+    gradient_average: bool = True
+    gradient_predivide_factor: float = 1.0
+    allreduce_always_fp32: bool = False
+    compression: Optional[str] = None
+
+    def __post_init__(self):
+        _fold_reduce_config(self)
 
     def reduce(self, grads: Any) -> Any:
         return reduce_gradients(grads, self.axis_name, self.config)
@@ -190,7 +219,14 @@ class Reducer:
     to reduce (e.g. every N accumulation steps)."""
 
     axis_name: str = "data"
-    config: ReduceConfig = ReduceConfig()
+    config: Optional[ReduceConfig] = None
+    gradient_average: bool = True
+    gradient_predivide_factor: float = 1.0
+    allreduce_always_fp32: bool = False
+    compression: Optional[str] = None
+
+    def __post_init__(self):
+        _fold_reduce_config(self)
 
     def reduce(self, grads: Any) -> Any:
         return reduce_gradients(grads, self.axis_name, self.config)

@@ -82,6 +82,74 @@ def batchnorm_forward(x: jax.Array, mean: jax.Array, invstd: jax.Array,
     return y.astype(x.dtype)
 
 
+def reduce_bn(grad_out: jax.Array, x: jax.Array, mean: jax.Array,
+              invstd: jax.Array, weight: Optional[jax.Array],
+              channel_axis: int):
+    """Local backward reductions (``syncbn.reduce_bn[_c_last]``,
+    ``welford.cu:323-384``): per-channel ``(mean_dy, mean_dy_xmu,
+    grad_weight, grad_bias)`` from local data.  The reference allreduces the
+    two means between this and :func:`batchnorm_backward`; under autodiff the
+    same split falls out of the traced forward, but the pieces are exported
+    for manual composition and conformance tests."""
+    ch = channel_axis % x.ndim
+    reduce_axes = tuple(a for a in range(x.ndim) if a != ch)
+    count = 1
+    for a in reduce_axes:
+        count *= x.shape[a]
+    shape = [1] * x.ndim
+    shape[ch] = x.shape[ch]
+    dy = grad_out.astype(jnp.float32)
+    xmu = x.astype(jnp.float32) - mean.reshape(shape)
+    sum_dy = dy.sum(axis=reduce_axes)
+    sum_dy_xmu = (dy * xmu).sum(axis=reduce_axes)
+    # grad_weight/grad_bias are computed unconditionally from the same sums
+    # (the reference kernel always produces them; welford.cu:323-384) — a
+    # bias-only BN still needs grad_bias.
+    grad_weight = sum_dy_xmu * invstd
+    grad_bias = sum_dy
+    return sum_dy / count, sum_dy_xmu / count, grad_weight, grad_bias
+
+
+def batchnorm_backward(grad_out: jax.Array, x: jax.Array, mean: jax.Array,
+                       invstd: jax.Array, weight: Optional[jax.Array],
+                       mean_dy: jax.Array, mean_dy_xmu: jax.Array,
+                       channel_axis: int) -> jax.Array:
+    """Elementwise grad_input from globally-reduced means
+    (``syncbn.batchnorm_backward[_c_last]``, ``welford.cu:385-411``)."""
+    ch = channel_axis % x.ndim
+    shape = [1] * x.ndim
+    shape[ch] = x.shape[ch]
+    dy = grad_out.astype(jnp.float32)
+    xmu = x.astype(jnp.float32) - mean.reshape(shape)
+    iv = invstd.reshape(shape)
+    gi = (dy - mean_dy.reshape(shape)
+          - xmu * jnp.square(iv) * mean_dy_xmu.reshape(shape)) * iv
+    if weight is not None:
+        gi = gi * weight.reshape(shape).astype(jnp.float32)
+    return gi.astype(x.dtype)
+
+
+# _c_last spellings: NHWC is TPU's native layout, so the reference's separate
+# channels-last kernels (welford.cu:586-829) collapse to channel_axis=-1 —
+# same code, exported under the reference names for inventory parity.
+def welford_mean_var_c_last(x: jax.Array):
+    return welford_mean_var(x, tuple(range(x.ndim - 1)))
+
+
+def batchnorm_forward_c_last(x, mean, invstd, weight, bias):
+    return batchnorm_forward(x, mean, invstd, weight, bias, channel_axis=-1)
+
+
+def reduce_bn_c_last(grad_out, x, mean, invstd, weight):
+    return reduce_bn(grad_out, x, mean, invstd, weight, channel_axis=-1)
+
+
+def batchnorm_backward_c_last(grad_out, x, mean, invstd, weight,
+                              mean_dy, mean_dy_xmu):
+    return batchnorm_backward(grad_out, x, mean, invstd, weight,
+                              mean_dy, mean_dy_xmu, channel_axis=-1)
+
+
 class SyncBatchNorm(nn.Module):
     """Cross-device BatchNorm (``apex.parallel.SyncBatchNorm``).
 

@@ -8,6 +8,12 @@ data by default; ``--size large`` selects BERT-large (the v5e-16 config),
 Data-parallel over all devices with ``--dp`` (shard_map over ("data",)).
 """
 
+# Make the repo root importable when run as "python examples/<name>.py"
+# without an install (the environment forbids pip install).
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import time
 

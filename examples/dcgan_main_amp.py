@@ -8,6 +8,12 @@ machinery (``apex/amp/handle.py:53-58``).  Here each network gets its own
 overflow in D's backward never shrinks G's scale.
 """
 
+# Make the repo root importable when run as "python examples/<name>.py"
+# without an install (the environment forbids pip install).
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import time
 

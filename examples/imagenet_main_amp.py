@@ -13,6 +13,12 @@ loader into ``data_iter`` for convergence runs (LR schedule per the
 reference "should yield 76%": 0.1·B/256, /10 at epochs 30/60/80).
 """
 
+# Make the repo root importable when run as "python examples/<name>.py"
+# without an install (the environment forbids pip install).
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import time
 

@@ -8,6 +8,12 @@ Run (any backend):
     python examples/mnist_amp.py --opt-level O1 --steps 200
 """
 
+# Make the repo root importable when run as "python examples/<name>.py"
+# without an install (the environment forbids pip install).
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import time
 

@@ -1,0 +1,98 @@
+"""Legacy / module-level amp API and fp16util wrapper parity.
+
+Covers the reference's two secondary entry styles (SURVEY.md §2 items 5, 7,
+10): ``amp.init`` → ``wrap_optimizer`` (``apex/amp/amp.py:68-177``,
+``opt.py:9-103``), module-level ``amp.scale_loss`` resolving through the
+active-amp global (``_amp_state``), and ``convert_module`` / ``FP16Model``
+(``fp16util.py:44-84``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from apex_tpu import amp, fp16_utils
+from apex_tpu.models.mlp import MLP, cross_entropy_loss
+
+
+def _data(key=0, n=64):
+    x = jax.random.normal(jax.random.PRNGKey(key), (n, 16))
+    y = (jnp.abs(x[:, 0] * 10).astype(jnp.int32)) % 4
+    return x, y
+
+
+def _model():
+    model = MLP(features=(32, 4))
+    params = model.init(jax.random.PRNGKey(1), jnp.zeros((1, 16)))["params"]
+    return model, params
+
+
+def test_legacy_init_wrap_optimizer_trains():
+    model, params = _model()
+    handle = amp.init(enabled=True, verbose=False)
+    try:
+        assert handle.is_active and not handle.has_cache
+        a = handle.wrap_optimizer(optax.sgd(0.1))
+        state = a.init(params)
+
+        def loss_fn(p, x, y):
+            return cross_entropy_loss(model.apply({"params": p}, x), y)
+
+        step = jax.jit(amp.make_train_step(a, loss_fn))
+        x, y = _data()
+        first = None
+        for _ in range(5):
+            state, m = step(state, x, y)
+            first = m["loss"] if first is None else first
+        assert float(m["loss"]) < float(first)
+        # handle.scale_loss routes through the wrapped optimizer's scaler
+        scaled = handle.scale_loss(jnp.asarray(2.0), state)
+        np.testing.assert_allclose(
+            float(scaled), 2.0 * float(state.scaler_states[0].loss_scale))
+    finally:
+        handle._deactivate()
+
+
+def test_legacy_init_disabled_returns_noop():
+    handle = amp.init(enabled=False)
+    assert not handle.is_active
+    assert float(handle.scale_loss(jnp.asarray(3.0), None)) == 3.0
+    a = handle.wrap_optimizer(optax.sgd(0.1))
+    assert not a.properties.enabled
+    handle._deactivate()
+
+
+def test_module_level_scale_loss_uses_active_amp():
+    a = amp.initialize(optimizer=optax.sgd(0.1), opt_level="O2",
+                       verbosity=0)
+    assert amp.active_amp() is a
+    _, params = _model()
+    state = a.init(params)
+    scaled = amp.scale_loss(jnp.asarray(1.5), state)
+    np.testing.assert_allclose(
+        float(scaled), 1.5 * float(state.scaler_states[0].loss_scale))
+
+
+def test_convert_module_casts_all_floats():
+    _, params = _model()
+    half = fp16_utils.convert_module(params, jnp.bfloat16)
+    assert all(l.dtype == jnp.bfloat16 for l in jax.tree.leaves(half))
+    back = fp16_utils.convert_module(half, jnp.float32)
+    assert all(l.dtype == jnp.float32 for l in jax.tree.leaves(back))
+
+
+def test_fp16_model_wrapper():
+    model, params = _model()
+    wrapped = fp16_utils.FP16Model(
+        lambda p, x: model.apply({"params": p}, x))
+    half_params = wrapped.convert(params)
+    assert all(l.dtype == jnp.bfloat16
+               for l in jax.tree.leaves(half_params))
+    x, _ = _data()
+    out = wrapped(half_params, x)          # fp32 input cast to bf16 inside
+    assert out.dtype == jnp.bfloat16
+    ref = model.apply({"params": half_params},
+                      x.astype(jnp.bfloat16))
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32))
