@@ -87,21 +87,20 @@ class ReduceConfig:
 def _fold_reduce_config(self) -> None:
     """Shared constructor logic for the wrappers that accept the reference
     knob spellings: fold them into ``config`` when none is given, reject
-    conflicting specifications."""
-    knobs = dict(gradient_average=self.gradient_average,
-                 gradient_predivide_factor=self.gradient_predivide_factor,
-                 allreduce_always_fp32=self.allreduce_always_fp32,
-                 compression=self.compression)
+    conflicting specifications.  Knob fields default to ``None`` ("not
+    passed") so an explicit knob equal to the ReduceConfig default still
+    conflicts detectably with an explicit ``config``."""
+    knobs = {k: getattr(self, k)
+             for k in ("gradient_average", "gradient_predivide_factor",
+                       "allreduce_always_fp32", "compression")}
+    passed = {k: v for k, v in knobs.items() if v is not None}
     if self.config is None:
-        object.__setattr__(self, "config", ReduceConfig(**knobs))
+        object.__setattr__(self, "config", ReduceConfig(**passed))
         return
-    defaults = ReduceConfig()
-    changed = {k: v for k, v in knobs.items()
-               if v != getattr(defaults, k)}
-    if changed:
+    if passed:
         raise ValueError(
             f"pass the reduction knobs either via config= or directly, "
-            f"not both (got config={self.config} and {changed})")
+            f"not both (got config={self.config} and {passed})")
 
 
 def pvary_params(params: Any, axis_name: str) -> Any:
@@ -176,9 +175,9 @@ class DistributedDataParallel:
     message_size: int = 10_000_000
     # Reference-constructor spellings (distributed.py:167-177); folded into
     # ``config`` when one isn't given explicitly.
-    gradient_average: bool = True
-    gradient_predivide_factor: float = 1.0
-    allreduce_always_fp32: bool = False
+    gradient_average: Optional[bool] = None
+    gradient_predivide_factor: Optional[float] = None
+    allreduce_always_fp32: Optional[bool] = None
     compression: Optional[str] = None
 
     def __post_init__(self):
@@ -220,9 +219,9 @@ class Reducer:
 
     axis_name: str = "data"
     config: Optional[ReduceConfig] = None
-    gradient_average: bool = True
-    gradient_predivide_factor: float = 1.0
-    allreduce_always_fp32: bool = False
+    gradient_average: Optional[bool] = None
+    gradient_predivide_factor: Optional[float] = None
+    allreduce_always_fp32: Optional[bool] = None
     compression: Optional[str] = None
 
     def __post_init__(self):

@@ -19,10 +19,15 @@ Statistics pipeline, matching the optimized path (§3.5 call stack):
    ``optimized_sync_batchnorm_kernel.py:48-51``);
 5. elementwise normalize in fp32, cast back to input dtype.
 
-The backward needs no hand-written two-stage kernel: the stat reduction and
-its ``psum`` are *inside* the traced forward, so JAX autodiff produces
-exactly the reference's ``reduce_bn → allreduce → batchnorm_backward`` split
-(``welford.cu:323-411``), with XLA fusing the elementwise parts.
+The backward IS the reference's hand-written two-stage split: train-mode
+normalization goes through :func:`_bn_train_apply`, a ``custom_vjp`` whose
+backward runs ``reduce_bn → allreduce → batchnorm_backward``
+(``welford.cu:323-411``).  Plain autodiff of the fp32 stats graph would
+save fp32 activation-sized residuals (double the HBM traffic of a bf16
+model); the custom VJP saves only the input at its own dtype plus
+per-channel fp32 vectors.  Trade-off: like the reference, train-mode BN
+supports reverse-mode AD only (``jax.jvp``/``jacfwd`` through a training
+graph raises; eval mode is unaffected).
 
 TPU note: channels-last is the native layout (the reference needed separate
 ``_c_last`` CUDA kernels; here any ``channel_axis`` compiles equally well).
@@ -30,6 +35,7 @@ TPU note: channels-last is the native layout (the reference needed separate
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional, Sequence
 
 import jax
@@ -150,6 +156,71 @@ def batchnorm_backward_c_last(grad_out, x, mean, invstd, weight,
                               mean_dy, mean_dy_xmu, channel_axis=-1)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _bn_train_apply(channel_axis, axis_name, process_group,
+                    x, mean, invstd, weight, bias):
+    """Normalize with batch statistics, with the reference's hand-written
+    backward (``reduce_bn → allreduce → batchnorm_backward``,
+    ``optimized_sync_batchnorm_kernel.py:83-101``) as a ``custom_vjp``.
+
+    The backward formula is the *total* derivative through the batch
+    statistics (mean/invstd are functions of x over the global batch), so
+    the saved-for-backward residuals are just the input at its own dtype
+    plus per-channel fp32 vectors — plain autodiff of the fp32 stats graph
+    instead saves fp32 activation-sized intermediates, doubling HBM traffic
+    for bf16 models.  Cotangents for ``mean``/``invstd`` are defined zero:
+    their dependence on ``x`` is folded into ``grad_input`` analytically.
+    """
+    return batchnorm_forward(x, mean, invstd, weight, bias, channel_axis)
+
+
+def _bn_train_fwd(channel_axis, axis_name, process_group,
+                  x, mean, invstd, weight, bias):
+    y = batchnorm_forward(x, mean, invstd, weight, bias, channel_axis)
+    return y, (x, mean, invstd, weight, bias)
+
+
+def _bn_train_bwd(channel_axis, axis_name, process_group, res, dy):
+    x, mean, invstd, weight, bias = res
+    mean_dy, mean_dy_xmu, gw, gb = reduce_bn(dy, x, mean, invstd, weight,
+                                             channel_axis)
+    if axis_name is not None:
+        # Global means of dy / dy·(x-µ): allreduce + divide by world size
+        # (kernel.py:91-97); equal per-rank counts assumed, as the
+        # reference does.  Grouped reductions ride all_gather + local mean,
+        # the same recipe (and VMA-compatibility reason) as the forward.
+        if process_group is not None:
+            # already a tuple-of-tuples (normalized by the caller; must be
+            # hashable as a nondiff arg)
+            mean_dy = lax.all_gather(
+                mean_dy, axis_name,
+                axis_index_groups=process_group).mean(axis=0)
+            mean_dy_xmu = lax.all_gather(
+                mean_dy_xmu, axis_name,
+                axis_index_groups=process_group).mean(axis=0)
+        else:
+            mean_dy = lax.pmean(mean_dy, axis_name)
+            mean_dy_xmu = lax.pmean(mean_dy_xmu, axis_name)
+    gi = batchnorm_backward(dy, x, mean, invstd, weight,
+                            mean_dy, mean_dy_xmu, channel_axis)
+    if axis_name is not None:
+        # weight/bias are replicated across the whole axis (even with BN
+        # sub-groups), so their cotangent is the full-axis sum — what
+        # autodiff's transpose-of-broadcast inserts implicitly.
+        if weight is not None:
+            gw = lax.psum(gw, axis_name)
+        if bias is not None:
+            gb = lax.psum(gb, axis_name)
+    return (gi,
+            jnp.zeros_like(mean),
+            jnp.zeros_like(invstd),
+            gw.astype(weight.dtype) if weight is not None else None,
+            gb.astype(bias.dtype) if bias is not None else None)
+
+
+_bn_train_apply.defvjp(_bn_train_fwd, _bn_train_bwd)
+
+
 class SyncBatchNorm(nn.Module):
     """Cross-device BatchNorm (``apex.parallel.SyncBatchNorm``).
 
@@ -257,11 +328,19 @@ class SyncBatchNorm(nn.Module):
             unbiased = var * total_count / jnp.maximum(total_count - 1.0, 1.0)
             m = self.momentum
             ra_mean.value = ((1.0 - m) * ra_mean.value.astype(jnp.float32)
-                             + m * mean).astype(self.running_dtype)
+                             + m * lax.stop_gradient(mean)
+                             ).astype(self.running_dtype)
             ra_var.value = ((1.0 - m) * ra_var.value.astype(jnp.float32)
-                            + m * unbiased).astype(self.running_dtype)
+                            + m * lax.stop_gradient(unbiased)
+                            ).astype(self.running_dtype)
 
-        return batchnorm_forward(x, mean, invstd, weight, bias, ch_axis)
+        # Train-mode normalize with the hand-written backward: residuals are
+        # x (own dtype) + per-channel fp32 vectors, not the fp32 stats graph.
+        groups = (tuple(map(tuple, self.process_group))
+                  if sync and self.process_group is not None else None)
+        return _bn_train_apply(ch_axis, self.axis_name if sync else None,
+                               groups, x, lax.stop_gradient(mean),
+                               lax.stop_gradient(invstd), weight, bias)
 
 
 # Local BatchNorm is the axis_name=None degenerate case; exported under the

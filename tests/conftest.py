@@ -17,6 +17,12 @@ import jax  # noqa: E402  (import after env setup)
 
 # The environment's sitecustomize pins jax_platforms to the TPU plugin;
 # override at the config level (env vars are ignored) so tests run on the
-# virtual 8-device CPU platform.
-jax.config.update("jax_platforms", "cpu")
+# virtual 8-device CPU platform.  Set APEX_TPU_TEST_PLATFORM to the hardware
+# platform's plugin name to run the suite on real chips instead — validates
+# the Pallas kernels compiled by Mosaic rather than in interpret mode (e.g.
+# "tpu", or "axon" under the tunnel where the chip registers as an
+# experimental platform; multi-device tests will fail where they need >1
+# chip).
+jax.config.update("jax_platforms",
+                  os.environ.get("APEX_TPU_TEST_PLATFORM", "cpu"))
 jax.config.update("jax_threefry_partitionable", True)
