@@ -10,5 +10,7 @@ from apex_tpu.attention.ring import (
     ring_attention,
     ulysses_attention,
 )
+from apex_tpu.ops.pallas.flash_attention import flash_attention
 
-__all__ = ["attention", "ring_attention", "ulysses_attention"]
+__all__ = ["attention", "ring_attention", "ulysses_attention",
+           "flash_attention"]

@@ -168,9 +168,20 @@ def attention(
     impl: str = "ring",
     **kwargs,
 ) -> jax.Array:
-    """Dispatcher: full local attention when ``axis_name`` is None, else
-    the selected sequence-parallel implementation."""
+    """Dispatcher: full local attention when ``axis_name`` is None (the
+    Pallas flash kernel on TPU, the jnp path elsewhere; force one with
+    ``impl="flash"`` / ``impl="jnp"``), else the selected sequence-parallel
+    implementation."""
     if axis_name is None:
+        if impl not in ("ring", "ulysses", "flash", "jnp"):
+            raise ValueError(f"unknown attention impl {impl!r}")
+        from apex_tpu.ops import use_pallas
+        if impl == "flash" or (impl != "jnp" and use_pallas()):
+            from apex_tpu.ops.pallas.flash_attention import flash_attention
+            return flash_attention(q, k, v,
+                                   causal=kwargs.get("causal", False),
+                                   kv_mask=kwargs.get("kv_mask"),
+                                   scale=kwargs.get("scale"))
         s = _block_scores(q, k, kwargs.get("scale") or 1.0 / (q.shape[-1] ** 0.5),
                           0, 0, kwargs.get("causal", False),
                           kwargs.get("kv_mask"))

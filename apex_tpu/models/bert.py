@@ -69,13 +69,28 @@ class SelfAttention(nn.Module):
             return t.reshape(t.shape[0], t.shape[1], c.num_heads, head_dim)
 
         q, k, v = heads(q), heads(k), heads(v)
-        scores = amp_ops.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(head_dim)
-        if mask is not None:
-            # mask: (B, L) 1 = attend; large negative in fp32
-            bias = (1.0 - mask[:, None, None, :].astype(jnp.float32)) * -1e9
-            scores = scores.astype(jnp.float32) + bias
-        probs = amp_ops.softmax(scores, axis=-1).astype(v.dtype)
-        out = amp_ops.einsum("bhqk,bkhd->bqhd", probs, v)
+        from apex_tpu.ops import use_pallas
+        if use_pallas():
+            # Fused blockwise attention — the (L, L) score matrix never
+            # hits HBM (apex_tpu.ops.pallas.flash_attention).
+            from apex_tpu.ops.pallas.flash_attention import flash_attention
+            kv_mask = None if mask is None else mask.astype(bool)
+            out = flash_attention(q, k, v, kv_mask=kv_mask,
+                                  scale=1.0 / float(head_dim) ** 0.5)
+        else:
+            scores = amp_ops.einsum("bqhd,bkhd->bhqk", q, k) \
+                / jnp.sqrt(head_dim)
+            if mask is not None:
+                # mask: (B, L) 1 = attend; large negative in fp32
+                bias = (1.0 - mask[:, None, None, :]
+                        .astype(jnp.float32)) * -1e9
+                scores = scores.astype(jnp.float32) + bias
+            probs = amp_ops.softmax(scores, axis=-1).astype(v.dtype)
+            if mask is not None:
+                # all-padding rows emit zeros, matching the flash branch
+                probs = jnp.where(mask[:, None, None, :].astype(bool),
+                                  probs, 0)
+            out = amp_ops.einsum("bhqk,bkhd->bqhd", probs, v)
         out = out.reshape(x.shape[0], x.shape[1], c.hidden_size)
         return Dense(c.hidden_size, name="out")(out)
 

@@ -1,0 +1,429 @@
+"""Pallas TPU flash attention (forward + hand-written backward).
+
+The reference has no attention kernels (a 2019 CNN/RNN-era library), but
+this framework treats long-context as first-class: the sequence-parallel
+paths (:mod:`apex_tpu.attention.ring`) and the BERT family need an
+attention primitive that never materializes the ``(L, L)`` score matrix in
+HBM.  This is the classic blockwise online-softmax scheme (Dao et al.,
+FlashAttention — pattern, not code) mapped onto the TPU:
+
+- the grid walks ``(batch·heads, q_block, k_block)`` with the k dimension
+  innermost; Mosaic's sequential grid makes the k-walk a legal accumulation
+  over VMEM scratch (running max ``m``, normalizer ``l``, fp32 ``acc``) —
+  the role CUDA shared-memory tiling plays for the GPU kernels;
+- score/softmax arithmetic is fp32 regardless of storage dtype (the amp
+  blacklist rule for softmax), matmuls ride the MXU with
+  ``preferred_element_type=float32``;
+- the backward is the standard two-pass recomputation from the saved
+  logsumexp: a ``dq`` pass (k innermost) and a ``dk/dv`` pass (q
+  innermost), each one Pallas call — no ``(L, L)`` tensor ever hits HBM.
+
+Masking: ``kv_mask`` (key padding) arrives as an additive fp32 bias row
+``(B, L)`` (0 = attend, ``NEG_INF`` = ignore); causal masking is computed
+from block offsets inside the kernel.  Fully-masked query rows produce
+``l = 0`` and emit zeros (masked-softmax convention, matching
+``apex_tpu.attention``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops import on_tpu
+
+_LANES = 128
+#: Minor-dim width for the per-row stats tensors (lse, delta) in HBM.
+#: Full lane width (128) is what jax's TPU flash kernel uses too: narrower
+#: widths save HBM (the stats are per-row scalars) but force Mosaic
+#: relayouts in the backward inner loop — measured on BERT-large L=512:
+#: width 1 → 10.6 seq/s, width 8 → 16.7, width 128 → 24.9.  The footprint
+#: only matters at extreme sequence lengths (2·BH·L·512 bytes).
+_STATS_W = _LANES
+NEG_INF = -1e30
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _causal_mask(bq, bk, q_start, k_start):
+    qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return qpos >= kpos
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal,
+                block_q, block_k, nk):
+    ik = pl.program_id(2)
+    iq = pl.program_id(1)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+    # Whole block strictly above the diagonal contributes nothing.
+    live = (not causal) or (k_start <= q_start + block_q - 1)
+
+    @pl.when(live)
+    def _update():
+        # fp32 operands measure faster here than bf16 (Mosaic relayout
+        # costs outweigh the MXU rate difference at d=64) and match the
+        # fp32-softmax policy exactly.
+        q = q_ref[0].astype(jnp.float32)          # (bq, d)
+        k = k_ref[0].astype(jnp.float32)          # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # (bq, bk)
+        s = s + bias_ref[0]                       # (1, bk) broadcast
+        mask = None
+        if causal:
+            mask = _causal_mask(block_q, block_k, q_start, k_start)
+            s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[...]                        # (bq, LANES) replicated
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # (bq, 1)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        corr = jnp.exp(m_prev - m_new)             # (bq, LANES)
+        p = jnp.exp(s - m_new[:, :1])              # (bq, bk)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+        p = jnp.where(bias_ref[0] > NEG_INF / 2, p, 0.0)
+        l_new = l_scr[...] * corr + jnp.broadcast_to(
+            p.sum(axis=1, keepdims=True), m_prev.shape)
+        pv = jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # (bq, d)
+        acc_scr[...] = acc_scr[...] * corr[:, :1] + pv
+        m_scr[...] = m_new
+        l_scr[...] = l_new
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        l = l_scr[:, :_STATS_W]                     # (bq, W) replicated
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / safe_l[:, :1]).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(l == 0.0, NEG_INF,
+                               m_scr[:, :_STATS_W] + jnp.log(safe_l))
+
+
+def _bwd_p(q, k, bias_row, lse_col, *, scale, causal, q_start, k_start,
+           block_q, block_k):
+    """Recompute the probability block from the saved logsumexp.
+    ``bias_row``: (1, bk); ``lse_col``: (bq, 1)."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    s = s + bias_row
+    p = jnp.exp(s - lse_col)
+    if causal:
+        p = jnp.where(_causal_mask(block_q, block_k, q_start, k_start),
+                      p, 0.0)
+    p = jnp.where(bias_row > NEG_INF / 2, p, 0.0)
+    # lse == NEG_INF marks fully-masked rows: their p must be 0.
+    p = jnp.where(lse_col > NEG_INF / 2, p, 0.0)
+    return p
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
+               dq_ref, dq_scr, *, scale, causal, block_q, block_k, nk):
+    ik = pl.program_id(2)
+    iq = pl.program_id(1)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+    live = (not causal) or (k_start <= q_start + block_q - 1)
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        p = _bwd_p(q, k, bias_ref[0], lse_ref[0][:, :1], scale=scale,
+                   causal=causal, q_start=q_start, k_start=k_start,
+                   block_q=block_q, block_k=block_k)
+        do = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bq, bk)
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                block_q, block_k, nq):
+    iq = pl.program_id(2)
+    ik = pl.program_id(1)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+    live = (not causal) or (k_start <= q_start + block_q - 1)
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        p = _bwd_p(q, k, bias_ref[0], lse_ref[0][:, :1], scale=scale,
+                   causal=causal, q_start=q_start, k_start=k_start,
+                   block_q=block_q, block_k=block_k)
+        do = do_ref[0].astype(jnp.float32)
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(jnp.float32), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1]) * scale      # (bq, bk)
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _pad_bhld(t, lp):
+    """(B, L, H, D) → (BH, Lp, D) with zero sequence padding."""
+    b, l, h, d = t.shape
+    t = jnp.moveaxis(t, 2, 1).reshape(b * h, l, d)
+    if lp != l:
+        t = jnp.pad(t, ((0, 0), (0, lp - l), (0, 0)))
+    return t
+
+
+def _prep(q, k, v, bias, block_q, block_k):
+    """(B, L, H, D) → padded (BH, Lp, D); pad the additive key bias with
+    ``NEG_INF`` so padded keys never attend."""
+    import math
+
+    l = q.shape[1]
+    lp = _ceil_to(l, math.lcm(block_q, block_k))
+    if bias is not None:
+        if lp != l:
+            bias = jnp.pad(bias, ((0, 0), (0, lp - l)),
+                           constant_values=NEG_INF)
+        bias = bias[:, None, :]        # (B, 1, Lp): Mosaic-legal row blocks
+    return _pad_bhld(q, lp), _pad_bhld(k, lp), _pad_bhld(v, lp), bias, lp
+
+
+def _unprep(t, b, l, h, d):
+    return jnp.moveaxis(t.reshape(b, h, -1, d)[:, :, :l, :], 1, 2)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "causal", "block_q", "block_k",
+                                    "num_heads"))
+def _flash_fwd(qf, kf, vf, bias, *, scale, causal, block_q, block_k,
+               num_heads):
+    bh, lp, d = qf.shape
+    nq, nk = lp // block_q, lp // block_k
+    grid = (bh, nq, nk)
+    h = num_heads
+
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nk=nk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh_, iq, ik: (bh_, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, iq, ik: (bh_, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, iq, ik: (bh_, ik, 0)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda bh_, iq, ik: (bh_ // h, 0, ik)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh_, iq, ik: (bh_, iq, 0)),
+            pl.BlockSpec((1, block_q, _STATS_W),
+                         lambda bh_, iq, ik: (bh_, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lp, d), qf.dtype),
+            # logsumexp replicated across a narrow minor dim: Mosaic-legal
+            # ("equal to the array dim") at 8x the scalar footprint
+            # instead of the 128-lane replication jax's kernel uses.
+            jax.ShapeDtypeStruct((bh, lp, _STATS_W), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=not on_tpu(),
+    )(qf, kf, vf, bias)
+    return o, lse
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "causal", "block_q", "block_k",
+                                    "num_heads"))
+def _flash_bwd(qf, kf, vf, of, do_f, lse, bias, *, scale, causal,
+               block_q, block_k, num_heads):
+    bh, lp, d = qf.shape
+    nq, nk = lp // block_q, lp // block_k
+    h = num_heads
+    delta = jnp.sum(of.astype(jnp.float32) * do_f.astype(jnp.float32),
+                    axis=-1, keepdims=True)                    # (bh, lp, 1)
+    delta = jnp.broadcast_to(delta, (bh, lp, _STATS_W))
+
+    common_in = [qf, kf, vf, do_f, lse, delta, bias]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nk=nk),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh_, iq, ik: (bh_, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, iq, ik: (bh_, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, iq, ik: (bh_, ik, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh_, iq, ik: (bh_, iq, 0)),
+            pl.BlockSpec((1, block_q, _STATS_W),
+                         lambda bh_, iq, ik: (bh_, iq, 0)),
+            pl.BlockSpec((1, block_q, _STATS_W),
+                         lambda bh_, iq, ik: (bh_, iq, 0)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda bh_, iq, ik: (bh_ // h, 0, ik)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh_, iq, ik: (bh_, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, lp, d), qf.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=not on_tpu(),
+    )(*common_in)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nq=nq),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh_, ik, iq: (bh_, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ik, iq: (bh_, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ik, iq: (bh_, ik, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh_, ik, iq: (bh_, iq, 0)),
+            pl.BlockSpec((1, block_q, _STATS_W),
+                         lambda bh_, ik, iq: (bh_, iq, 0)),
+            pl.BlockSpec((1, block_q, _STATS_W),
+                         lambda bh_, ik, iq: (bh_, iq, 0)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda bh_, ik, iq: (bh_ // h, 0, ik)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh_, ik, iq: (bh_, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ik, iq: (bh_, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lp, d), qf.dtype),
+            jax.ShapeDtypeStruct((bh, lp, d), qf.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=not on_tpu(),
+    )(*common_in)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, bias, scale, causal, block_q, block_k):
+    out, _ = _flash_core(q, k, v, bias, scale, causal, block_q, block_k)
+    return out
+
+
+def _flash_core(q, k, v, bias, scale, causal, block_q, block_k):
+    b, l, h, d = q.shape
+    qf, kf, vf, bias_p, lp = _prep(q, k, v, bias, block_q, block_k)
+    of, lse = _flash_fwd(qf, kf, vf, bias_p, scale=scale, causal=causal,
+                         block_q=block_q, block_k=block_k, num_heads=h)
+    return _unprep(of, b, l, h, d), (qf, kf, vf, of, lse, bias_p)
+
+
+def _flash_fwd_rule(q, k, v, bias, scale, causal, block_q, block_k):
+    out, res = _flash_core(q, k, v, bias, scale, causal, block_q, block_k)
+    return out, (res, q.shape)
+
+
+def _flash_bwd_rule(scale, causal, block_q, block_k, saved, dout):
+    (qf, kf, vf, of, lse, bias_p), (b, l, h, d) = saved
+    do_f = _pad_bhld(dout, qf.shape[1])
+    dqf, dkf, dvf = _flash_bwd(qf, kf, vf, of, do_f, lse, bias_p,
+                               scale=scale, causal=causal, block_q=block_q,
+                               block_k=block_k, num_heads=h)
+    dq = _unprep(dqf, b, l, h, d)
+    dk = _unprep(dkf, b, l, h, d)
+    dv = _unprep(dvf, b, l, h, d)
+    return dq, dk, dv, jnp.zeros((b, l), jnp.float32)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _jnp_attention(q, k, v, *, causal, kv_mask, scale):
+    """Materializing jnp path with the kernel's exact conventions (fp32
+    softmax, masked rows emit zeros) — the cross-attention fallback."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    visible = jnp.ones((q.shape[0], 1, q.shape[1], k.shape[1]), bool)
+    if kv_mask is not None:
+        visible = visible & kv_mask[:, None, None, :]
+    if causal:
+        qpos = jnp.arange(q.shape[1])[:, None]
+        kpos = jnp.arange(k.shape[1])[None, :]
+        visible = visible & (qpos >= kpos)[None, None]
+    s = jnp.where(visible, s, NEG_INF)
+    m = s.max(axis=-1, keepdims=True)
+    p = jnp.where(visible, jnp.exp(s - m), 0.0)
+    l = p.sum(axis=-1, keepdims=True)
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p / safe_l, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
+                    block_q=512, block_k=512):
+    """Blockwise exact attention, ``(B, L, H, D)`` convention.
+
+    Equivalent to the jnp reference path in :mod:`apex_tpu.attention`
+    (scores never materialized; fp32 softmax; masked rows emit zeros).
+    ``kv_mask``: optional ``(B, Lk)`` bool key mask (True = attend).
+    ``block_q``/``block_k`` are clamped to the (padded) sequence length.
+    Cross-attention (``Lq != Lk``) routes to an equivalent jnp path — the
+    blockwise kernel packs q and k/v with one shared sequence length.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    b, l = q.shape[0], q.shape[1]
+    if k.shape[1] != l:
+        return _jnp_attention(q, k, v, causal=causal, kv_mask=kv_mask,
+                              scale=float(scale))
+    block_q = min(block_q, _ceil_to(l, 128))
+    block_k = min(block_k, _ceil_to(l, 128))
+    if kv_mask is not None:
+        bias = jnp.where(kv_mask, 0.0, NEG_INF).astype(jnp.float32)
+    else:
+        bias = jnp.zeros((b, l), jnp.float32)
+    return _flash(q, k, v, bias, float(scale), bool(causal),
+                  int(block_q), int(block_k))

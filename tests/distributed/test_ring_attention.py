@@ -32,7 +32,10 @@ def _qkv(seed=0, dtype=jnp.float32):
 
 
 def _reference(q, k, v, causal=False, kv_mask=None):
-    return attention(q, k, v, axis_name=None, causal=causal,
+    # Pin the oracle to the jnp path: on hardware the auto-dispatching
+    # attention() would route to the Pallas flash kernel, making this a
+    # kernel-vs-kernel comparison instead of kernel-vs-jnp.
+    return attention(q, k, v, axis_name=None, impl="jnp", causal=causal,
                      kv_mask=kv_mask)
 
 

@@ -1,0 +1,127 @@
+"""Flash-attention kernel conformance (interpret mode on CPU; set
+APEX_TPU_TEST_PLATFORM to run Mosaic-compiled on hardware).
+
+The harness mirrors the multi-tensor fuzz style (SURVEY.md §4.1): kernel
+output and gradients vs a pure-jnp oracle across causal/mask/dtype/odd-
+length axes, with the masked-row and padding edge cases planted explicitly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.pallas.flash_attention import flash_attention
+
+B, L, H, D = 2, 384, 4, 64
+
+# The oracle einsums run at precision="highest" so they are exact on TPU
+# too; the kernel's MXU matmuls use the default f32 decomposition
+# (bf16-multipass), which differs from a full-f32 oracle at the ~1e-2
+# level after softmax renormalization — the same precision class as
+# jax's own TPU flash kernel, hence the looser on-hardware tolerance.
+_ON_CPU = jax.default_backend() == "cpu"
+RTOL = 1e-5 if _ON_CPU else 2e-2
+ATOL = 1e-5 if _ON_CPU else 2e-2
+GTOL = 1e-4 if _ON_CPU else 2e-2
+
+
+def ref_attn(q, k, v, causal=False, kv_mask=None):
+    """jnp oracle; fully-masked rows emit zeros like the kernel."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32), precision="highest") * scale
+    neg = jnp.asarray(-1e30, jnp.float32)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, neg)
+    if causal:
+        tri = jnp.tril(jnp.ones((q.shape[1], q.shape[1]), bool))
+        s = jnp.where(tri[None, None], s, neg)
+    m = s.max(axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    if kv_mask is not None:
+        p = jnp.where(kv_mask[:, None, None, :], p, 0.0)
+    if causal:
+        p = jnp.where(tri[None, None], p, 0.0)
+    l = p.sum(axis=-1, keepdims=True)
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p / safe_l, v.astype(jnp.float32),
+                     precision="highest")
+    return out.astype(q.dtype)
+
+
+def _qkv(dtype=jnp.float32, l=L, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(B, l, H, D).astype(np.float32)
+                             ).astype(dtype)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("use_mask", [False, True])
+def test_forward_matches_reference(causal, use_mask):
+    q, k, v = _qkv()
+    mask = None
+    if use_mask:
+        rng = np.random.RandomState(1)
+        mask = jnp.asarray(rng.rand(B, L) > 0.2).at[:, 0].set(True)
+    out = flash_attention(q, k, v, causal=causal, kv_mask=mask,
+                          block_q=128, block_k=128)
+    ref = ref_attn(q, k, v, causal=causal, kv_mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_gradients_match_reference():
+    q, k, v = _qkv()
+    rng = np.random.RandomState(1)
+    mask = jnp.asarray(rng.rand(B, L) > 0.2).at[:, 0].set(True)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(
+            jnp.sin(fn(q, k, v)).astype(jnp.float32))
+
+    gf = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, kv_mask=mask, block_q=128, block_k=128)),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(lambda q, k, v: ref_attn(
+        q, k, v, causal=True, kv_mask=mask)), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=GTOL, atol=GTOL)
+
+
+def test_odd_length_padding_and_bf16():
+    q, k, v = _qkv(jnp.bfloat16, l=300)
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+    ref = ref_attn(q, k, v, causal=True)
+    assert out.dtype == jnp.bfloat16 and out.shape == q.shape
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_fully_masked_rows_emit_zeros():
+    q, k, v = _qkv(l=256)
+    mask = jnp.zeros((B, 256), bool).at[0].set(True)   # batch 1 all-masked
+    out = flash_attention(q, k, v, kv_mask=mask, block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(out[1]), 0.0)
+    assert bool(jnp.any(out[0] != 0.0))
+
+
+def test_fully_masked_rows_zero_gradients():
+    q, k, v = _qkv(l=256)
+    mask = jnp.zeros((B, 256), bool).at[0].set(True)
+    g = jax.grad(lambda q: jnp.sum(flash_attention(
+        q, k, v, kv_mask=mask, block_q=128, block_k=128)
+        .astype(jnp.float32)))(q)
+    np.testing.assert_allclose(np.asarray(g[1]), 0.0)
+
+
+def test_dispatcher_uses_flash():
+    from apex_tpu.attention import attention
+    q, k, v = _qkv(l=256)
+    out = attention(q, k, v, impl="flash", causal=True)
+    ref = ref_attn(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=RTOL, atol=ATOL)
