@@ -35,6 +35,11 @@ from jax import lax
 NEG_INF = -1e30
 
 
+def _use_pallas_blocks() -> bool:
+    from apex_tpu.ops import use_pallas
+    return use_pallas()
+
+
 def _block_scores(q, k, scale, q_off, k_off, causal, kv_mask):
     """fp32 attention scores for one (local-q, rotating-k) block pair."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
@@ -50,6 +55,80 @@ def _block_scores(q, k, scale, q_off, k_off, causal, kv_mask):
     return s
 
 
+def _ring_attention_flash(q, k, v, axis_name, causal, kv_mask, scale):
+    """Ring attention with the Pallas flash kernel as the per-step block
+    engine: each hop computes an exact local attention (out, lse) pair and
+    merges it into the carry by logsumexp weighting — no ``(L/W, L/W)``
+    score tensor ever hits HBM.  The merge is differentiable because
+    :func:`flash_attention` exposes a differentiable ``lse``."""
+    from apex_tpu.ops.pallas.flash_attention import NEG_INF as FLASH_NEG
+    from apex_tpu.ops.pallas.flash_attention import flash_attention
+
+    world = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    b, l_local, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    perm = [(i, (i + 1) % world) for i in range(world)]
+
+    vary = lambda t: lax.pvary(t, (axis_name,))
+    o = vary(jnp.zeros((b, l_local, h, d), jnp.float32))
+    lse = vary(jnp.full((b, l_local, h), FLASH_NEG, jnp.float32))
+    mask_c = (vary(jnp.ones((b, l_local), bool))
+              if kv_mask is None else kv_mask)
+
+    def step(t, carry):
+        k_t, v_t, mask_t, o, lse = carry
+        src = (rank - t) % world
+
+        def full_block(_):
+            ot, lt = flash_attention(q, k_t, v_t, causal=False,
+                                     kv_mask=mask_t, scale=scale,
+                                     return_lse=True)
+            return ot.astype(jnp.float32), lt
+
+        def diag_block(_):
+            ot, lt = flash_attention(q, k_t, v_t, causal=True,
+                                     kv_mask=mask_t, scale=scale,
+                                     return_lse=True)
+            return ot.astype(jnp.float32), lt
+
+        def skip_block(_):
+            # literal zeros must be tagged device-varying to type-match the
+            # other switch branches under VMA checking
+            return (vary(jnp.zeros((b, l_local, h, d), jnp.float32)),
+                    vary(jnp.full((b, l_local, h), FLASH_NEG, jnp.float32)))
+
+        if causal:
+            # src < rank: fully visible; src == rank: local causal;
+            # src > rank: entirely in the future.
+            branch = jnp.where(src == rank, 1,
+                               jnp.where(src < rank, 0, 2))
+            o_t, lse_t = lax.switch(branch,
+                                    [full_block, diag_block, skip_block],
+                                    None)
+        else:
+            o_t, lse_t = full_block(None)
+
+        # logsumexp-weighted merge of two normalized partial results.
+        m = jnp.maximum(lse, lse_t)
+        w1 = jnp.exp(lse - m)
+        w2 = jnp.exp(lse_t - m)
+        tot = w1 + w2
+        o_new = (o * w1[:, :, :, None]
+                 + o_t * w2[:, :, :, None]) / tot[:, :, :, None]
+        lse_new = m + jnp.log(tot)
+
+        k_n = lax.ppermute(k_t, axis_name, perm)
+        v_n = lax.ppermute(v_t, axis_name, perm)
+        mask_n = lax.ppermute(mask_t, axis_name, perm)
+        return k_n, v_n, mask_n, o_new, lse_new
+
+    _, _, _, o, lse = lax.fori_loop(0, world, step,
+                                    (k, v, mask_c, o, lse))
+    return o.astype(q.dtype)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -58,6 +137,7 @@ def ring_attention(
     causal: bool = False,
     kv_mask: Optional[jax.Array] = None,
     scale: Optional[float] = None,
+    impl: Optional[str] = None,
 ) -> jax.Array:
     """Exact attention with the sequence dimension sharded over
     ``axis_name``; call inside ``shard_map``.
@@ -67,7 +147,16 @@ def ring_attention(
     k/v (True = attend).  Online-softmax state (running max ``m``, running
     normalizer ``l``, fp32 accumulator) is carried across the W ring steps;
     K/V (and the mask) advance one hop per step with ``ppermute``.
+
+    On TPU the per-step block attention runs the Pallas flash kernel
+    (``impl="flash"`` forces it, ``impl="jnp"`` forces the materializing
+    path).
     """
+    if impl not in (None, "flash", "jnp"):
+        raise ValueError(f"unknown ring impl {impl!r}")
+    if impl == "flash" or (impl is None and _use_pallas_blocks()):
+        return _ring_attention_flash(q, k, v, axis_name, causal, kv_mask,
+                                     scale)
     world = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     b, l_local, h, d = q.shape
@@ -147,6 +236,13 @@ def ulysses_attention(
     mask_f = (lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)
               if kv_mask is not None else None)
 
+    if _use_pallas_blocks():
+        from apex_tpu.ops.pallas.flash_attention import flash_attention
+        out = flash_attention(qf, kf, vf, causal=causal, kv_mask=mask_f,
+                              scale=scale)
+        return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
     s = _block_scores(qf, kf, scale, 0, 0, causal, mask_f)
     m = s.max(axis=-1, keepdims=True)
     p = jnp.exp(s - m)
@@ -171,12 +267,12 @@ def attention(
     """Dispatcher: full local attention when ``axis_name`` is None (the
     Pallas flash kernel on TPU, the jnp path elsewhere; force one with
     ``impl="flash"`` / ``impl="jnp"``), else the selected sequence-parallel
-    implementation."""
+    implementation (``impl="flash"``/``"jnp"`` with an ``axis_name`` select
+    the ring path's block engine)."""
+    if impl not in ("ring", "ulysses", "flash", "jnp"):
+        raise ValueError(f"unknown attention impl {impl!r}")
     if axis_name is None:
-        if impl not in ("ring", "ulysses", "flash", "jnp"):
-            raise ValueError(f"unknown attention impl {impl!r}")
-        from apex_tpu.ops import use_pallas
-        if impl == "flash" or (impl != "jnp" and use_pallas()):
+        if impl == "flash" or (impl != "jnp" and _use_pallas_blocks()):
             from apex_tpu.ops.pallas.flash_attention import flash_attention
             return flash_attention(q, k, v,
                                    causal=kwargs.get("causal", False),
@@ -191,8 +287,8 @@ def attention(
         safe_l = jnp.where(l == 0.0, 1.0, l)
         return jnp.einsum("bhqk,bkhd->bqhd", p / safe_l,
                           v.astype(jnp.float32)).astype(q.dtype)
-    if impl == "ring":
-        return ring_attention(q, k, v, axis_name, **kwargs)
     if impl == "ulysses":
         return ulysses_attention(q, k, v, axis_name, **kwargs)
-    raise ValueError(f"unknown sequence-parallel impl {impl!r}")
+    if impl in ("flash", "jnp"):
+        return ring_attention(q, k, v, axis_name, impl=impl, **kwargs)
+    return ring_attention(q, k, v, axis_name, **kwargs)

@@ -51,6 +51,19 @@ def _ceil_to(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying the varying-across-mesh-axes (vma) type of
+    ``like`` — required for pallas_call outputs under ``shard_map``'s VMA
+    checking (the ring/ulysses paths run this kernel per shard)."""
+    try:
+        vma = jax.typeof(like).vma
+    except Exception:
+        vma = None
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _causal_mask(bq, bk, q_start, k_start):
     qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -263,11 +276,10 @@ def _flash_fwd(qf, kf, vf, bias, *, scale, causal, block_q, block_k,
                          lambda bh_, iq, ik: (bh_, iq, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, lp, d), qf.dtype),
-            # logsumexp replicated across a narrow minor dim: Mosaic-legal
-            # ("equal to the array dim") at 8x the scalar footprint
-            # instead of the 128-lane replication jax's kernel uses.
-            jax.ShapeDtypeStruct((bh, lp, _STATS_W), jnp.float32),
+            _sds((bh, lp, d), qf.dtype, qf),
+            # logsumexp replicated across the stats minor dim (see
+            # _STATS_W).
+            _sds((bh, lp, _STATS_W), jnp.float32, qf),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -282,13 +294,14 @@ def _flash_fwd(qf, kf, vf, bias, *, scale, causal, block_q, block_k,
 @functools.partial(jax.jit,
                    static_argnames=("scale", "causal", "block_q", "block_k",
                                     "num_heads"))
-def _flash_bwd(qf, kf, vf, of, do_f, lse, bias, *, scale, causal,
+def _flash_bwd(qf, kf, vf, of, do_f, lse, bias, dlse_f, *, scale, causal,
                block_q, block_k, num_heads):
     bh, lp, d = qf.shape
     nq, nk = lp // block_q, lp // block_k
     h = num_heads
     delta = jnp.sum(of.astype(jnp.float32) * do_f.astype(jnp.float32),
                     axis=-1, keepdims=True)                    # (bh, lp, 1)
+    delta = delta - dlse_f[..., None]      # lse cotangent folds into delta
     delta = jnp.broadcast_to(delta, (bh, lp, _STATS_W))
 
     common_in = [qf, kf, vf, do_f, lse, delta, bias]
@@ -311,7 +324,7 @@ def _flash_bwd(qf, kf, vf, of, do_f, lse, bias, *, scale, causal,
         ],
         out_specs=pl.BlockSpec((1, block_q, d),
                                lambda bh_, iq, ik: (bh_, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, lp, d), qf.dtype),
+        out_shape=_sds((bh, lp, d), qf.dtype, qf),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=not on_tpu(),
     )(*common_in)
@@ -337,8 +350,8 @@ def _flash_bwd(qf, kf, vf, of, do_f, lse, bias, *, scale, causal,
             pl.BlockSpec((1, block_k, d), lambda bh_, ik, iq: (bh_, ik, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, lp, d), qf.dtype),
-            jax.ShapeDtypeStruct((bh, lp, d), qf.dtype),
+            _sds((bh, lp, d), qf.dtype, qf),
+            _sds((bh, lp, d), qf.dtype, qf),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
@@ -349,8 +362,14 @@ def _flash_bwd(qf, kf, vf, of, do_f, lse, bias, *, scale, causal,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash(q, k, v, bias, scale, causal, block_q, block_k):
-    out, _ = _flash_core(q, k, v, bias, scale, causal, block_q, block_k)
-    return out
+    (out, lse_pub), _ = _flash_core(q, k, v, bias, scale, causal,
+                                    block_q, block_k)
+    return out, lse_pub
+
+
+def _lse_public(lse, b, l, h):
+    """Internal (BH, Lp, W) logsumexp → public (B, L, H) fp32."""
+    return jnp.moveaxis(lse[:, :, 0].reshape(b, h, -1)[:, :, :l], 1, 2)
 
 
 def _flash_core(q, k, v, bias, scale, causal, block_q, block_k):
@@ -358,18 +377,27 @@ def _flash_core(q, k, v, bias, scale, causal, block_q, block_k):
     qf, kf, vf, bias_p, lp = _prep(q, k, v, bias, block_q, block_k)
     of, lse = _flash_fwd(qf, kf, vf, bias_p, scale=scale, causal=causal,
                          block_q=block_q, block_k=block_k, num_heads=h)
-    return _unprep(of, b, l, h, d), (qf, kf, vf, of, lse, bias_p)
+    return ((_unprep(of, b, l, h, d), _lse_public(lse, b, l, h)),
+            (qf, kf, vf, of, lse, bias_p))
 
 
 def _flash_fwd_rule(q, k, v, bias, scale, causal, block_q, block_k):
-    out, res = _flash_core(q, k, v, bias, scale, causal, block_q, block_k)
-    return out, (res, q.shape)
+    outs, res = _flash_core(q, k, v, bias, scale, causal, block_q, block_k)
+    return outs, (res, q.shape)
 
 
-def _flash_bwd_rule(scale, causal, block_q, block_k, saved, dout):
+def _flash_bwd_rule(scale, causal, block_q, block_k, saved, cotangents):
+    dout, dlse = cotangents
     (qf, kf, vf, of, lse, bias_p), (b, l, h, d) = saved
-    do_f = _pad_bhld(dout, qf.shape[1])
-    dqf, dkf, dvf = _flash_bwd(qf, kf, vf, of, do_f, lse, bias_p,
+    lp = qf.shape[1]
+    do_f = _pad_bhld(dout, lp)
+    # A cotangent on the logsumexp folds into the backward as an offset on
+    # delta: ds_ij = p_ij (dp_ij - delta_i + dlse_i), since dlse_i/ds_ij =
+    # p_ij.  Zero-cotangent callers (plain attention) pay nothing.
+    dlse_f = jnp.moveaxis(dlse.astype(jnp.float32), 1, 2).reshape(b * h, l)
+    if lp != l:
+        dlse_f = jnp.pad(dlse_f, ((0, 0), (0, lp - l)))
+    dqf, dkf, dvf = _flash_bwd(qf, kf, vf, of, do_f, lse, bias_p, dlse_f,
                                scale=scale, causal=causal, block_q=block_q,
                                block_k=block_k, num_heads=h)
     dq = _unprep(dqf, b, l, h, d)
@@ -381,9 +409,11 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, saved, dout):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def _jnp_attention(q, k, v, *, causal, kv_mask, scale):
+def _jnp_attention(q, k, v, *, causal, kv_mask, scale, return_lse=False):
     """Materializing jnp path with the kernel's exact conventions (fp32
-    softmax, masked rows emit zeros) — the cross-attention fallback."""
+    softmax, masked rows emit zeros) — the cross-attention fallback and
+    the interpret-mode stand-in under ``shard_map`` (see
+    :func:`flash_attention`)."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     visible = jnp.ones((q.shape[0], 1, q.shape[1], k.shape[1]), bool)
@@ -399,11 +429,23 @@ def _jnp_attention(q, k, v, *, causal, kv_mask, scale):
     l = p.sum(axis=-1, keepdims=True)
     safe_l = jnp.where(l == 0.0, 1.0, l)
     out = jnp.einsum("bhqk,bkhd->bqhd", p / safe_l, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    out = out.astype(q.dtype)
+    if not return_lse:
+        return out
+    lse = jnp.where(l[..., 0] == 0.0, NEG_INF,
+                    m[..., 0] + jnp.log(safe_l[..., 0]))   # (b, h, lq)
+    return out, jnp.moveaxis(lse, 1, 2)
+
+
+def _varying(x) -> bool:
+    try:
+        return bool(jax.typeof(x).vma)
+    except Exception:
+        return False
 
 
 def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
-                    block_q=512, block_k=512):
+                    block_q=512, block_k=512, return_lse=False):
     """Blockwise exact attention, ``(B, L, H, D)`` convention.
 
     Equivalent to the jnp reference path in :mod:`apex_tpu.attention`
@@ -412,18 +454,33 @@ def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
     ``block_q``/``block_k`` are clamped to the (padded) sequence length.
     Cross-attention (``Lq != Lk``) routes to an equivalent jnp path — the
     blockwise kernel packs q and k/v with one shared sequence length.
+
+    With ``return_lse`` also returns the per-row logsumexp ``(B, L, H)``
+    fp32 (``NEG_INF`` for fully-masked rows) — differentiable, so partial
+    results can be merged online (ring attention's carry).
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     b, l = q.shape[0], q.shape[1]
     if k.shape[1] != l:
+        if return_lse:
+            raise ValueError("return_lse requires Lq == Lk (kernel path)")
         return _jnp_attention(q, k, v, causal=causal, kv_mask=kv_mask,
                               scale=float(scale))
+    if not on_tpu() and _varying(q):
+        # Interpret-mode pallas under shard_map trips a VMA propagation
+        # limitation in jax's interpreter (dynamic_slice with mixed manual
+        # axes); compiled Mosaic is unaffected.  Use the equivalent jnp
+        # math so CPU-mesh tests of ring/ulysses still exercise the
+        # merge algebra.
+        return _jnp_attention(q, k, v, causal=causal, kv_mask=kv_mask,
+                              scale=float(scale), return_lse=return_lse)
     block_q = min(block_q, _ceil_to(l, 128))
     block_k = min(block_k, _ceil_to(l, 128))
     if kv_mask is not None:
         bias = jnp.where(kv_mask, 0.0, NEG_INF).astype(jnp.float32)
     else:
         bias = jnp.zeros((b, l), jnp.float32)
-    return _flash(q, k, v, bias, float(scale), bool(causal),
-                  int(block_q), int(block_k))
+    out, lse = _flash(q, k, v, bias, float(scale), bool(causal),
+                      int(block_q), int(block_k))
+    return (out, lse) if return_lse else out
