@@ -84,13 +84,7 @@ class Amp:
         """Build the initial state from user fp32 params (reference
         ``_initialize.py:176-177`` requires incoming fp32; we cast to be safe,
         mirroring ``allow_incoming_model_not_fp32`` leniency)."""
-        p = self.properties
-        if p.enabled and self._use_master_weights():
-            master = jax.tree.map(lambda x: x.astype(jnp.float32)
-                                  if jnp.issubdtype(x.dtype, jnp.floating) else x,
-                                  params)
-        else:
-            master = self.model_params_from(params)
+        master = self._master_from(params)
         return AmpState(
             master_params=master,
             opt_state=self.tx.init(master),
@@ -98,6 +92,18 @@ class Amp:
                                 for _ in range(self.num_losses)),
             step=jnp.zeros((), jnp.int32),
         )
+
+    def _master_from(self, params: Any) -> Any:
+        """Derive the carried ("master") representation of a param subtree
+        — fp32 clones under master weights, compute-precision otherwise.
+        Shared by :meth:`init` and :meth:`add_params` so the policy cannot
+        diverge between original and later-added subtrees."""
+        p = self.properties
+        if p.enabled and self._use_master_weights():
+            return jax.tree.map(
+                lambda x: x.astype(jnp.float32)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+        return self.model_params_from(params)
 
     def _use_master_weights(self) -> bool:
         p = self.properties
@@ -130,6 +136,45 @@ class Amp:
         the reference's master→model fused copy
         (``_process_optimizer.py:242-253``)."""
         return self.model_params_from(state.master_params)
+
+    def add_params(self, state: AmpState, new_params: Any) -> AmpState:
+        """Grow the carried state with a new top-level param subtree — the
+        functional analog of the reference's patched
+        ``optimizer.add_param_group`` (``_process_optimizer.py:331-407``),
+        which extends the master/fp16 group lists consistently.
+
+        Both ``state.master_params`` and ``new_params`` must be dicts at
+        the top level, with disjoint keys.  Optimizer state for existing
+        params (moments, step counters) is preserved: the new union state
+        is initialized fresh and every leaf whose tree path already
+        existed (same shape/dtype) is grafted back from the old state.
+        """
+        master = state.master_params
+        if not isinstance(master, dict) or not isinstance(new_params, dict):
+            raise TypeError("add_params requires dict param trees")
+        overlap = set(master) & set(new_params)
+        if overlap:
+            raise ValueError(f"params already present: {sorted(overlap)}")
+
+        merged = {**master, **self._master_from(new_params)}
+
+        old_leaves = {
+            jax.tree_util.keystr(path): leaf
+            for path, leaf in jax.tree_util.tree_leaves_with_path(
+                state.opt_state)
+        }
+
+        def graft(path, fresh_leaf):
+            old = old_leaves.get(jax.tree_util.keystr(path))
+            if old is not None and hasattr(old, "shape") and \
+                    getattr(old, "shape", None) == fresh_leaf.shape and \
+                    getattr(old, "dtype", None) == fresh_leaf.dtype:
+                return old
+            return fresh_leaf
+
+        fresh = self.tx.init(merged)
+        opt_state = jax.tree_util.tree_map_with_path(graft, fresh)
+        return AmpState(merged, opt_state, state.scaler_states, state.step)
 
     # ------------------------------------------------------------------
     # model application (reference _initialize.py:197-208 forward patch)

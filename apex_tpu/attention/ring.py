@@ -211,9 +211,12 @@ def ulysses_attention(
     causal: bool = False,
     kv_mask: Optional[jax.Array] = None,
     scale: Optional[float] = None,
+    impl: Optional[str] = None,
 ) -> jax.Array:
     """All-to-all sequence parallelism: trade the sequence sharding for a
     head sharding, attend over the full sequence locally, trade back.
+    ``impl="flash"``/``"jnp"`` forces the local attention engine (auto:
+    flash on TPU).
 
     Requires ``heads % world == 0``.  One fused all-to-all each way on ICI;
     preferable to the ring when heads are plentiful and the sequence fits
@@ -236,20 +239,20 @@ def ulysses_attention(
     mask_f = (lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)
               if kv_mask is not None else None)
 
-    if _use_pallas_blocks():
+    if impl not in (None, "flash", "jnp"):
+        raise ValueError(f"unknown ulysses impl {impl!r}")
+    if impl == "flash" or (impl is None and _use_pallas_blocks()):
         from apex_tpu.ops.pallas.flash_attention import flash_attention
         out = flash_attention(qf, kf, vf, causal=causal, kv_mask=mask_f,
                               scale=scale)
-        return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
-                              tiled=True)
-
-    s = _block_scores(qf, kf, scale, 0, 0, causal, mask_f)
-    m = s.max(axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    l = p.sum(axis=-1, keepdims=True)
-    safe_l = jnp.where(l == 0.0, 1.0, l)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p / safe_l,
-                     vf.astype(jnp.float32)).astype(q.dtype)
+    else:
+        s = _block_scores(qf, kf, scale, 0, 0, causal, mask_f)
+        m = s.max(axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = p.sum(axis=-1, keepdims=True)
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p / safe_l,
+                         vf.astype(jnp.float32)).astype(q.dtype)
 
     # (B, L, H/W, D) -> (B, L/W, H, D)
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,

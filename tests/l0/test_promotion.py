@@ -1,0 +1,119 @@
+"""Type-promotion policy tests (port of ``tests/L0/run_amp/test_promotion.py``
+and the add_param_group lifecycle, ``test_add_param_group.py:34-148``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.amp import ops as amp_ops
+from apex_tpu.amp.policy import resolve
+
+HALF = jnp.bfloat16
+PROPS = resolve(opt_level="O1", half_dtype=HALF)
+
+
+def _ctx():
+    return amp_ops.cast_context(PROPS)
+
+
+def test_binary_promote_widest_type():
+    h = jnp.ones((4,), HALF)
+    f = jnp.ones((4,), jnp.float32)
+    with _ctx():
+        assert amp_ops.add(h, h).dtype == HALF
+        assert amp_ops.add(h, f).dtype == jnp.float32
+        assert amp_ops.mul(f, h).dtype == jnp.float32
+        assert amp_ops.maximum(h, f).dtype == jnp.float32
+
+
+def test_sequence_promote_cat_stack():
+    h = jnp.ones((4,), HALF)
+    f = jnp.ones((4,), jnp.float32)
+    with _ctx():
+        assert amp_ops.concatenate([h, h]).dtype == HALF
+        assert amp_ops.concatenate([h, f]).dtype == jnp.float32
+        assert amp_ops.stack([f, h]).dtype == jnp.float32
+
+
+def test_banned_bce_raises_on_half():
+    h = jnp.full((4,), 0.5, HALF)
+    with _ctx():
+        with pytest.raises(NotImplementedError):
+            amp_ops.binary_cross_entropy(h, h)
+    # fp32 inputs pass
+    with _ctx():
+        out = amp_ops.binary_cross_entropy(jnp.full((4,), 0.5),
+                                           jnp.full((4,), 0.5))
+        assert jnp.isfinite(out)
+
+
+def test_disable_casts_suspends_policy():
+    h = jnp.ones((4,), HALF)
+    with _ctx():
+        assert amp_ops.exp(h).dtype == jnp.float32      # blacklist casts up
+        with amp_ops.disable_casts():
+            assert amp_ops.exp(h).dtype == HALF          # passthrough
+
+
+def test_no_policy_is_passthrough():
+    h = jnp.ones((4,), HALF)
+    assert amp_ops.add(h, jnp.ones((4,), jnp.float32)).dtype == jnp.float32
+    assert amp_ops.exp(h).dtype == HALF
+
+
+# --- add_param_group lifecycle (reference test_add_param_group.py) ---------
+
+
+def _loss(params, x):
+    out = x
+    for k in sorted(params):
+        out = out @ params[k]["w"]
+    return jnp.sum(jnp.square(out))
+
+
+def test_add_params_preserves_existing_optimizer_state():
+    from apex_tpu.optimizers import FusedAdam
+    rng = np.random.RandomState(0)
+    p0 = {"g0": {"w": jnp.asarray(rng.randn(8, 8).astype(np.float32))}}
+    x = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+
+    a = amp.initialize(optimizer=FusedAdam(lr=1e-2), opt_level="O2",
+                       verbosity=0)
+    state = a.init(p0)
+    step = jax.jit(amp.make_train_step(a, _loss))
+    for _ in range(3):
+        state, _ = step(state, x)
+    m_before = jax.tree.leaves(state.opt_state)[0]
+
+    p1 = {"g1": {"w": jnp.asarray(rng.randn(8, 8).astype(np.float32))}}
+    state2 = a.add_params(state, p1)
+    assert set(state2.master_params) == {"g0", "g1"}
+    # existing moments grafted, not reset
+    flat2 = {jax.tree_util.keystr(k): v for k, v in
+             jax.tree_util.tree_leaves_with_path(state2.opt_state)}
+    flat1 = {jax.tree_util.keystr(k): v for k, v in
+             jax.tree_util.tree_leaves_with_path(state.opt_state)}
+    for key, old in flat1.items():
+        if hasattr(old, "shape") and key in flat2:
+            np.testing.assert_array_equal(np.asarray(flat2[key]),
+                                          np.asarray(old))
+    # training continues over the union
+    step2 = jax.jit(amp.make_train_step(a, _loss))
+    state3, metrics = step2(state2, x)
+    assert bool(jnp.isfinite(metrics["loss"]))
+    assert not np.allclose(np.asarray(state3.master_params["g1"]["w"]),
+                           np.asarray(state2.master_params["g1"]["w"]))
+
+
+def test_add_params_rejects_overlap_and_nondict():
+    a = amp.initialize(optimizer=optax.sgd(0.1), opt_level="O2",
+                       verbosity=0)
+    state = a.init({"g0": jnp.ones((2,))})
+    with pytest.raises(ValueError):
+        a.add_params(state, {"g0": jnp.ones((2,))})
+    with pytest.raises(TypeError):
+        a.add_params(state, [jnp.ones((2,))])
