@@ -148,6 +148,13 @@ class Amp:
         params (moments, step counters) is preserved: the new union state
         is initialized fresh and every leaf whose tree path already
         existed (same shape/dtype) is grafted back from the old state.
+
+        Caveat vs the reference: optimizers with one *global* step counter
+        (FusedAdam/FusedLAMB here) keep that counter, so bias correction
+        treats the new subtree as mid-training (its zero moments warm up
+        over ~1/(1-beta) steps with slightly larger first updates).  The
+        reference's per-group step starts new groups at 0; use a
+        per-param-count optimizer if that exact behavior matters.
         """
         master = state.master_params
         if not isinstance(master, dict) or not isinstance(new_params, dict):
