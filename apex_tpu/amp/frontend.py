@@ -242,6 +242,12 @@ class Amp:
         reference DDP which allreduces scaled fp16 grads before unscaling.
         ``stashed_grads`` selects the gradient-accumulation path
         (``unscale_with_stashed``, ``_process_optimizer.py:125-129``).
+        On that path the finite check covers the *combined* unscaled
+        grads, not just the new micro-batch: an inf from any earlier
+        micro-batch persists through the stashed adds, so checking the
+        combination reproduces the reference's shared overflow buffer
+        (which accumulates across every unscale of the iteration) with no
+        caller cooperation.
 
         Returns ``(new_state, info)`` with ``info = {"overflow", "loss_scale"}``
         — both device arrays; nothing here syncs to the host.
@@ -260,8 +266,11 @@ class Amp:
 
         sstate = state.scaler_states[loss_id]
         if stashed_grads is not None:
-            grads_unscaled, finite = self.scaler.unscale_with_stashed(
+            grads_unscaled, _ = self.scaler.unscale_with_stashed(
                 grads, stashed_grads, sstate)
+            # Stale non-finites from earlier micro-batches survive the
+            # adds (inf+x = inf / nan), so this subsumes the arg-0 check.
+            finite = scaler_lib.all_finite(grads_unscaled)
         else:
             grads_unscaled, finite = self.scaler.unscale(grads, sstate)
         # Grads land at each param's dtype: fp32 under master weights; model
