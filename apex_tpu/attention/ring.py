@@ -40,6 +40,18 @@ def _use_pallas_blocks() -> bool:
     return use_pallas()
 
 
+def _vary_like(reference_array, axis_name):
+    """``pvary`` tagger matching the full varying-axes set of an operand:
+    under a multi-dim mesh the inputs may vary over more axes than the
+    ring axis (e.g. a batch axis), and loop carries / switch branches must
+    type-match them exactly."""
+    try:
+        vma = tuple(jax.typeof(reference_array).vma) or (axis_name,)
+    except Exception:
+        vma = (axis_name,)
+    return lambda t: lax.pvary(t, vma)
+
+
 def _block_scores(q, k, scale, q_off, k_off, causal, kv_mask):
     """fp32 attention scores for one (local-q, rotating-k) block pair."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
@@ -71,7 +83,7 @@ def _ring_attention_flash(q, k, v, axis_name, causal, kv_mask, scale):
         scale = 1.0 / (d ** 0.5)
     perm = [(i, (i + 1) % world) for i in range(world)]
 
-    vary = lambda t: lax.pvary(t, (axis_name,))
+    vary = _vary_like(q, axis_name)
     o = vary(jnp.zeros((b, l_local, h, d), jnp.float32))
     lse = vary(jnp.full((b, l_local, h), FLASH_NEG, jnp.float32))
     mask_c = (vary(jnp.ones((b, l_local), bool))
@@ -167,7 +179,7 @@ def ring_attention(
     # literal-initialized carries must be tagged device-varying so the loop
     # carry type matches the (varying) step outputs under shard_map's VMA
     # checking
-    vary = lambda t: lax.pvary(t, (axis_name,))
+    vary = _vary_like(q, axis_name)
     m = vary(jnp.full((b, h, l_local), NEG_INF, jnp.float32))
     l = vary(jnp.zeros((b, h, l_local), jnp.float32))
     acc = vary(jnp.zeros((b, l_local, h, d), jnp.float32))

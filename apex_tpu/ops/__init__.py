@@ -34,3 +34,26 @@ def use_pallas() -> bool:
     if mode == "jnp":
         return False
     return on_tpu()
+
+
+def sds(shape, dtype, *likes):
+    """ShapeDtypeStruct for a pallas_call output, carrying the union of the
+    varying-across-mesh-axes (vma) types of the ``likes`` operands —
+    required when the kernel runs inside ``shard_map`` under VMA checking
+    (multi-chip optimizer steps, sequence-parallel attention).  Pass every
+    operand the output depends on; an output computed from any varying
+    input is varying."""
+    vma = None
+    for like in likes:
+        try:
+            v = jax.typeof(like).vma
+        except Exception:
+            continue
+        if v is not None:
+            vma = frozenset(v) if vma is None else vma | frozenset(v)
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    # NB: an empty frozenset (fully replicated operands) must still be
+    # passed through — under shard_map's VMA checking "vma=None" is an
+    # error even for replicated outputs.
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.ops import on_tpu
+from apex_tpu.ops import on_tpu, sds
 
 #: Flat buffers must be padded to a multiple of this (8 sublanes × 128 lanes
 #: × 8 rows of work per tile keeps every operand a well-formed fp32 tile).
@@ -87,13 +87,13 @@ def packed_adam(p: jax.Array, m: jax.Array, v: jax.Array, g: jax.Array,
         return pl.BlockSpec((block_rows, lanes), lambda i: (i, 0))
 
     out_shape = [
-        jax.ShapeDtypeStruct((rows, lanes), p.dtype),
-        jax.ShapeDtypeStruct((rows, lanes), m.dtype),
-        jax.ShapeDtypeStruct((rows, lanes), v.dtype),
+        sds((rows, lanes), p.dtype, p, g),
+        sds((rows, lanes), m.dtype, p, g),
+        sds((rows, lanes), v.dtype, p, g),
     ]
     out_specs = [spec(), spec(), spec()]
     if p_copy_dtype is not None:
-        out_shape.append(jax.ShapeDtypeStruct((rows, lanes), p_copy_dtype))
+        out_shape.append(sds((rows, lanes), p_copy_dtype, p, g))
         out_specs.append(spec())
 
     outs = pl.pallas_call(

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.ops import on_tpu
+from apex_tpu.ops import on_tpu, sds as _sds
 
 _LANES = 128
 #: Minor-dim width for the per-row stats tensors (lse, delta) in HBM.
@@ -51,17 +51,6 @@ def _ceil_to(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def _sds(shape, dtype, like):
-    """ShapeDtypeStruct carrying the varying-across-mesh-axes (vma) type of
-    ``like`` — required for pallas_call outputs under ``shard_map``'s VMA
-    checking (the ring/ulysses paths run this kernel per shard)."""
-    try:
-        vma = jax.typeof(like).vma
-    except Exception:
-        vma = None
-    if vma:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
-    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _causal_mask(bq, bk, q_start, k_start):

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.ops import on_tpu
+from apex_tpu.ops import on_tpu, sds
 from apex_tpu.ops.pallas.multi_tensor_kernels import _LANES, _block, _view2d
 
 #: Base chunk size for aligned packing: one (8, 128) fp32 tile per grid step.
@@ -105,7 +105,7 @@ def packed_lamb_stage1(g: jax.Array, p: jax.Array, m: jax.Array,
             spec(), spec(), spec(), spec(),
         ],
         out_specs=[spec(), spec(), spec()],
-        out_shape=[jax.ShapeDtypeStruct((n // _LANES, _LANES), jnp.float32)
+        out_shape=[sds((n // _LANES, _LANES), jnp.float32, g, p)
                    for _ in range(3)],
         interpret=not on_tpu(),
     )(scalars, per_chunk_decay.astype(jnp.float32), _view2d(g), _view2d(p),
@@ -134,11 +134,10 @@ def packed_lamb_stage2(p: jax.Array, u: jax.Array,
     def spec():
         return pl.BlockSpec(br, lambda i: (i, 0))
 
-    out_shape = [jax.ShapeDtypeStruct((n // _LANES, _LANES), p.dtype)]
+    out_shape = [sds((n // _LANES, _LANES), p.dtype, p, u)]
     out_specs = [spec()]
     if p_copy_dtype is not None:
-        out_shape.append(jax.ShapeDtypeStruct((n // _LANES, _LANES),
-                                              p_copy_dtype))
+        out_shape.append(sds((n // _LANES, _LANES), p_copy_dtype, p, u))
         out_specs.append(spec())
 
     outs = pl.pallas_call(

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.ops import on_tpu
+from apex_tpu.ops import on_tpu, sds
 
 _BLOCK_ROWS = 128
 
@@ -111,9 +111,9 @@ def _forward(x2d, w, b, eps: float, affine: bool):
             pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((rows, n2), x2d.dtype),
-            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
-            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            sds((rows, n2), x2d.dtype, x2d),
+            sds((rows, 1), jnp.float32, x2d),
+            sds((rows, 1), jnp.float32, x2d),
         ],
         interpret=not on_tpu(),
     )(xp, w2, b2)
@@ -148,9 +148,9 @@ def _backward(dy, x2d, w, mean, inv, affine: bool):
             pl.BlockSpec((1, n2), lambda i: (0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((rows, n2), x2d.dtype),
-            jax.ShapeDtypeStruct((1, n2), jnp.float32),
-            jax.ShapeDtypeStruct((1, n2), jnp.float32),
+            sds((rows, n2), x2d.dtype, x2d),
+            sds((1, n2), jnp.float32, x2d),
+            sds((1, n2), jnp.float32, x2d),
         ],
         interpret=not on_tpu(),
     )(dyp, xp, w2, meanp, invp)

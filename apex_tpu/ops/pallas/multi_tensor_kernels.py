@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.ops import on_tpu
+from apex_tpu.ops import on_tpu, sds
 
 _LANES = 128
 
@@ -81,8 +81,8 @@ def packed_scale(flat: jax.Array, scale: jax.Array, chunk_size: int,
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n // _LANES, _LANES), out_dtype),
-            jax.ShapeDtypeStruct((1,), jnp.int32),
+            sds((n // _LANES, _LANES), out_dtype, flat),
+            sds((1,), jnp.int32, flat),
         ],
         interpret=not on_tpu(),
     )(jnp.asarray(scale, jnp.float32).reshape(1), _view2d(flat))
@@ -136,8 +136,8 @@ def packed_axpby(x_flat: jax.Array, y_flat: jax.Array, a: jax.Array,
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n // _LANES, _LANES), out_dtype),
-            jax.ShapeDtypeStruct((1,), jnp.int32),
+            sds((n // _LANES, _LANES), out_dtype, x_flat),
+            sds((1,), jnp.int32, x_flat),
         ],
         interpret=not on_tpu(),
     )(ab, _view2d(x_flat), _view2d(y_flat))
@@ -168,7 +168,7 @@ def packed_sumsq(flat: jax.Array, chunk_size: int) -> jax.Array:
         grid=(n_chunks,),
         in_specs=[pl.BlockSpec(br, lambda i: (i, 0))],
         out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
-        out_shape=jax.ShapeDtypeStruct((1,), jnp.float32),
+        out_shape=sds((1,), jnp.float32, flat),
         interpret=not on_tpu(),
     )(_view2d(flat))
     return acc[0]
