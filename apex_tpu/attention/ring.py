@@ -46,7 +46,7 @@ def _vary_like(reference_array, axis_name):
     ring axis (e.g. a batch axis), and loop carries / switch branches must
     type-match them exactly."""
     try:
-        vma = tuple(jax.typeof(reference_array).vma) or (axis_name,)
+        vma = tuple(set(jax.typeof(reference_array).vma) | {axis_name})
     except Exception:
         vma = (axis_name,)
     return lambda t: lax.pvary(t, vma)

@@ -87,13 +87,13 @@ def packed_adam(p: jax.Array, m: jax.Array, v: jax.Array, g: jax.Array,
         return pl.BlockSpec((block_rows, lanes), lambda i: (i, 0))
 
     out_shape = [
-        sds((rows, lanes), p.dtype, p, g),
-        sds((rows, lanes), m.dtype, p, g),
-        sds((rows, lanes), v.dtype, p, g),
+        sds((rows, lanes), p.dtype, p, g, m, v),
+        sds((rows, lanes), m.dtype, p, g, m, v),
+        sds((rows, lanes), v.dtype, p, g, m, v),
     ]
     out_specs = [spec(), spec(), spec()]
     if p_copy_dtype is not None:
-        out_shape.append(sds((rows, lanes), p_copy_dtype, p, g))
+        out_shape.append(sds((rows, lanes), p_copy_dtype, p, g, m, v))
         out_specs.append(spec())
 
     outs = pl.pallas_call(

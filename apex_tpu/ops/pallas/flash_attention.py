@@ -51,8 +51,6 @@ def _ceil_to(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-
-
 def _causal_mask(bq, bk, q_start, k_start):
     qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)

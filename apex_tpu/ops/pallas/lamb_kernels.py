@@ -105,7 +105,7 @@ def packed_lamb_stage1(g: jax.Array, p: jax.Array, m: jax.Array,
             spec(), spec(), spec(), spec(),
         ],
         out_specs=[spec(), spec(), spec()],
-        out_shape=[sds((n // _LANES, _LANES), jnp.float32, g, p)
+        out_shape=[sds((n // _LANES, _LANES), jnp.float32, g, p, m, v)
                    for _ in range(3)],
         interpret=not on_tpu(),
     )(scalars, per_chunk_decay.astype(jnp.float32), _view2d(g), _view2d(p),

@@ -148,9 +148,9 @@ def _backward(dy, x2d, w, mean, inv, affine: bool):
             pl.BlockSpec((1, n2), lambda i: (0, 0)),
         ],
         out_shape=[
-            sds((rows, n2), x2d.dtype, x2d),
-            sds((1, n2), jnp.float32, x2d),
-            sds((1, n2), jnp.float32, x2d),
+            sds((rows, n2), x2d.dtype, x2d, dy, w),
+            sds((1, n2), jnp.float32, x2d, dy, w),
+            sds((1, n2), jnp.float32, x2d, dy, w),
         ],
         interpret=not on_tpu(),
     )(dyp, xp, w2, meanp, invp)
