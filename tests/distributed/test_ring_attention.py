@@ -228,3 +228,24 @@ def test_ring_flash_kernel_on_tpu():
                                rtol=2e-2, atol=2e-2)
     g = jax.grad(lambda q: jnp.sum(jax.jit(run)(q).astype(jnp.float32)))(q)
     assert bool(jnp.isfinite(g).all())
+
+
+@pytest.mark.parametrize("impl", ["jnp", "flash", "ring", "ulysses"])
+def test_dispatcher_forwards_impl_with_axis(mesh, impl):
+    """attention() with an axis_name accepts every impl: ring/ulysses
+    dispatch their path, flash/jnp select the ring block engine."""
+    q, k, v = _qkv(9)
+    want = _reference(q, k, v, causal=True)
+    got = _run_sharded(
+        mesh, lambda q, k, v: attention(q, k, v, axis_name="data",
+                                        impl=impl, causal=True), q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_dispatcher_rejects_unknown_impl():
+    q = jnp.zeros((1, 8, 2, 4))
+    with pytest.raises(ValueError):
+        attention(q, q, q, impl="flsah")
+    with pytest.raises(ValueError):
+        attention(q, q, q, axis_name="data", impl="flsah")
