@@ -18,11 +18,22 @@ from apex_tpu.models.bert import (
 )
 from apex_tpu.models.dcgan import Discriminator, Generator, gan_losses
 from apex_tpu.models.mlp import MLP, AmpDense, cross_entropy_loss
-from apex_tpu.models.resnet import ResNet, ResNet18, ResNet50
+from apex_tpu.models.resnet import (
+    ARCHS,
+    BasicBlock,
+    Bottleneck,
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
 
 __all__ = [
     "MLP", "AmpDense", "cross_entropy_loss",
-    "ResNet", "ResNet50", "ResNet18",
+    "ResNet", "ResNet50", "ResNet18", "ResNet34", "ResNet101", "ResNet152",
+    "ARCHS", "BasicBlock", "Bottleneck",
     "BertConfig", "BertModel", "BertForPreTraining",
     "bert_large", "bert_base", "bert_tiny", "pretraining_loss",
     "Generator", "Discriminator", "gan_losses",

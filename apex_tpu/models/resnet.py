@@ -25,17 +25,26 @@ from apex_tpu.layers import Conv, Dense
 from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
 
 
+def _bn(name, axis_name, process_group):
+    """Shared BN constructor for all blocks (SyncBatchNorm defaults match
+    the reference: momentum 0.1, eps 1e-5)."""
+    return SyncBatchNorm(axis_name=axis_name, process_group=process_group,
+                         momentum=0.1, epsilon=1e-5, name=name)
+
+
 class Bottleneck(nn.Module):
-    features: int               # base width; output is 4x
+    features: int               # base width; output is expansion-x
     strides: int = 1
     downsample: bool = False
     bn_axis_name: Optional[str] = None
     bn_process_group: Optional[Sequence[Sequence[int]]] = None
 
+    #: output-channel multiplier — the property the stage-0 projection
+    #: decision keys on (torchvision's ``expansion``)
+    expansion = 4
+
     def _bn(self, name):
-        return SyncBatchNorm(axis_name=self.bn_axis_name,
-                             process_group=self.bn_process_group,
-                             momentum=0.1, epsilon=1e-5, name=name)
+        return _bn(name, self.bn_axis_name, self.bn_process_group)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -56,12 +65,44 @@ class Bottleneck(nn.Module):
         return nn.relu(y + residual.astype(y.dtype))
 
 
+class BasicBlock(nn.Module):
+    """Two-conv residual block (torchvision ``BasicBlock``) — the block of
+    ResNet-18/34; no channel expansion."""
+
+    features: int
+    strides: int = 1
+    downsample: bool = False
+    bn_axis_name: Optional[str] = None
+    bn_process_group: Optional[Sequence[Sequence[int]]] = None
+
+    expansion = 1
+
+    def _bn(self, name):
+        return _bn(name, self.bn_axis_name, self.bn_process_group)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        residual = x
+        y = Conv(self.features, 3, strides=self.strides, name="conv1")(x)
+        y = self._bn("bn1")(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = Conv(self.features, 3, name="conv2")(y)
+        y = self._bn("bn2")(y, use_running_average=not train)
+        if self.downsample:
+            residual = Conv(self.features, 1, strides=self.strides,
+                            name="downsample_conv")(x)
+            residual = self._bn("downsample_bn")(
+                residual, use_running_average=not train)
+        return nn.relu(y + residual.astype(y.dtype))
+
+
 class ResNet(nn.Module):
     """ResNet-v1.5; ``stage_sizes=(3,4,6,3)`` is ResNet-50."""
 
     stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)
     num_classes: int = 1000
     width: int = 64
+    block_cls: Any = Bottleneck
     bn_axis_name: Optional[str] = None
     bn_process_group: Optional[Sequence[Sequence[int]]] = None
 
@@ -76,10 +117,17 @@ class ResNet(nn.Module):
         for stage, n_blocks in enumerate(self.stage_sizes):
             for block in range(n_blocks):
                 strides = 2 if stage > 0 and block == 0 else 1
-                y = Bottleneck(
+                # Expanding blocks need a projection even at stage 0's
+                # first block (channel count changes); expansion-1 blocks
+                # only when the shape actually changes (stride-2 entry of
+                # stages 1+).
+                downsample = block == 0 and (
+                    stage > 0
+                    or getattr(self.block_cls, "expansion", 1) != 1)
+                y = self.block_cls(
                     features=self.width * (2 ** stage),
                     strides=strides,
-                    downsample=(block == 0),
+                    downsample=downsample,
                     bn_axis_name=self.bn_axis_name,
                     bn_process_group=self.bn_process_group,
                     name=f"stage{stage}_block{block}",
@@ -93,7 +141,26 @@ def ResNet50(**kw) -> ResNet:
     return ResNet(stage_sizes=(3, 4, 6, 3), **kw)
 
 
+def ResNet101(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 23, 3), **kw)
+
+
+def ResNet152(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 8, 36, 3), **kw)
+
+
 def ResNet18(**kw) -> ResNet:
-    """Smaller sibling for tests; still bottleneck blocks (keeps one code
-    path) — (2,2,2,2) stages."""
+    """torchvision-style ResNet-18: BasicBlock, (2,2,2,2) stages."""
+    kw.setdefault("block_cls", BasicBlock)
     return ResNet(stage_sizes=(2, 2, 2, 2), **kw)
+
+
+def ResNet34(**kw) -> ResNet:
+    kw.setdefault("block_cls", BasicBlock)
+    return ResNet(stage_sizes=(3, 4, 6, 3), **kw)
+
+
+#: ``--arch`` string → constructor (the torchvision ``models.__dict__``
+#: lookup of the reference example, ``examples/imagenet/main_amp.py``).
+ARCHS = {"resnet18": ResNet18, "resnet34": ResNet34, "resnet50": ResNet50,
+         "resnet101": ResNet101, "resnet152": ResNet152}

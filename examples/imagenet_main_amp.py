@@ -29,7 +29,7 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu import amp
-from apex_tpu.models.resnet import ResNet50
+from apex_tpu.models.resnet import ARCHS
 from apex_tpu.optimizers import FusedAdam
 from apex_tpu.parallel import (
     DistributedDataParallel,
@@ -41,7 +41,8 @@ from apex_tpu.utils import maybe_print
 
 def parse_args():
     p = argparse.ArgumentParser()
-    p.add_argument("--arch", default="resnet50")
+    p.add_argument("--arch", default="resnet50",
+               choices=sorted(ARCHS))
     p.add_argument("-b", "--batch-size", type=int, default=128,
                    help="per-device batch")
     p.add_argument("--lr", type=float, default=None,
@@ -100,7 +101,7 @@ def main():
         seed = int(time.time())
 
     n_dev = len(jax.devices()) if args.dp else 1
-    model = ResNet50()
+    model = ARCHS[args.arch]()
     if args.sync_bn:
         if not args.dp:
             raise SystemExit("--sync-bn requires --dp: the \"data\" mesh "

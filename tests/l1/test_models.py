@@ -32,6 +32,22 @@ class TestResNet:
         self.x = jnp.asarray(np.random.RandomState(0)
                              .randn(4, 32, 32, 3).astype(np.float32))
 
+    def test_bottleneck_variant_trains(self):
+        """Small-scale coverage of the Bottleneck block — the block of the
+        flagship ResNet-50 — since ResNet18 is BasicBlock-based."""
+        from apex_tpu.models.resnet import ResNet50
+        model = ResNet50(num_classes=10, width=8)
+        x = self.x
+        variables = model.init(jax.random.PRNGKey(0), x, train=True)
+        logits, updated = model.apply(
+            variables, x, train=True, mutable=["batch_stats"])
+        assert logits.shape == (4, 10)
+        assert bool(jnp.isfinite(logits).all())
+        g = jax.grad(lambda p: jnp.sum(model.apply(
+            {"params": p, "batch_stats": variables["batch_stats"]}, x,
+            train=True, mutable=["batch_stats"])[0]))(variables["params"])
+        assert all(bool(jnp.isfinite(l).all()) for l in jax.tree.leaves(g))
+
     def init(self):
         return self.model.init(jax.random.PRNGKey(0), self.x, train=True)
 
