@@ -252,6 +252,9 @@ class Amp:
         Returns ``(new_state, info)`` with ``info = {"overflow", "loss_scale"}``
         — both device arrays; nothing here syncs to the host.
         """
+        if reduce_fn is not None:
+            grads = reduce_fn(grads)
+
         if not self.properties.enabled:
             updates, opt_state = self.tx.update(grads, state.opt_state,
                                                 state.master_params)
@@ -261,49 +264,145 @@ class Amp:
                     {"overflow": jnp.asarray(False),
                      "loss_scale": jnp.asarray(1.0, jnp.float32)})
 
-        if reduce_fn is not None:
-            grads = reduce_fn(grads)
-
         sstate = state.scaler_states[loss_id]
         if stashed_grads is not None:
             grads_unscaled, _ = self.scaler.unscale_with_stashed(
                 grads, stashed_grads, sstate)
             # Stale non-finites from earlier micro-batches survive the
-            # adds (inf+x = inf / nan), so this subsumes the arg-0 check.
+            # adds (inf+x = inf / nan), so checking the combination
+            # subsumes the reference's arg-0 check with no caller
+            # cooperation (see unscale_gradients for the strict arg-0
+            # per-loss policy).
             finite = scaler_lib.all_finite(grads_unscaled)
         else:
             grads_unscaled, finite = self.scaler.unscale(grads, sstate)
-        # Grads land at each param's dtype: fp32 under master weights; model
-        # dtype without them (O3), so opt-state dtypes stay fixed across the
-        # cond branches (the reference's no-master-weights variants unscale
-        # in place at model dtype, ``_process_optimizer.py:165-239``).
+        state, overflow = self.update_scaler(state, loss_id, finite)
+        new_state = self.step_if(state, grads_unscaled, overflow)
+        return new_state, {
+            "overflow": overflow,
+            "loss_scale": new_state.scaler_states[loss_id].loss_scale}
+
+    # ------------------------------------------------------------------
+    # composable pieces for multi-loss / multi-optimizer topologies
+    # (reference: one `with amp.scale_loss(loss_i, opts_j, loss_id=k)` per
+    # backward, each exit unscaling into the shared master grads, updating
+    # scaler k, and arming skip_step on every optimizer it was passed —
+    # handle.py:110-150, tests/L0/run_amp/test_multiple_models_optimizers_losses.py)
+    # ------------------------------------------------------------------
+    def unscale_gradients(
+        self, state: AmpState, grads: Any, loss_id: int = 0,
+        stashed_grads: Optional[Any] = None,
+    ) -> Tuple[Any, jax.Array]:
+        """Unscale one backward's grads with scaler ``loss_id``; returns
+        ``(unscaled, finite)``.  The finite check follows the reference's
+        arg-0 policy on the stashed path (``scaler.py:167-172``): only the
+        *new* grads are checked, so a stale inf in ``stashed_grads`` (from
+        another loss's backward) is never attributed to this scaler."""
+        sstate = state.scaler_states[loss_id]
+        if stashed_grads is not None:
+            return self.scaler.unscale_with_stashed(grads, stashed_grads,
+                                                    sstate)
+        return self.scaler.unscale(grads, sstate)
+
+    def update_scaler(self, state: AmpState, loss_id: int,
+                      grads_finite: jax.Array) -> Tuple[AmpState, jax.Array]:
+        """Run scaler ``loss_id``'s post-backward transition
+        (``update_scale``, ``scaler.py:190-210``) without stepping.
+        Returns ``(state_with_new_scaler, overflow)``."""
+        new_sstate, overflow = self.scaler.update(
+            state.scaler_states[loss_id], grads_finite)
+        scaler_states = tuple(
+            new_sstate if i == loss_id else s
+            for i, s in enumerate(state.scaler_states))
+        return state._replace(scaler_states=scaler_states), overflow
+
+    def step_if(self, state: AmpState, grads_unscaled: Any,
+                skip: jax.Array) -> AmpState:
+        """Conditionally apply the optimizer step on already-unscaled grads
+        — the ``lax.cond`` core of :meth:`apply_gradients`, split out so
+        multi-loss/multi-optimizer drivers can route overflow flags across
+        optimizers (the reference arms ``skip_step`` on every optimizer a
+        ``scale_loss`` context was passed, ``handle.py:131-150``)."""
         grads_unscaled = jax.tree.map(
             lambda g, p: g.astype(p.dtype) if hasattr(p, "dtype") else g,
             grads_unscaled, state.master_params)
-        new_sstate, overflow = self.scaler.update(sstate, finite)
 
         def do_step(operand):
             master, opt_state = operand
             updates, new_opt_state = self.tx.update(grads_unscaled, opt_state,
                                                     master)
-            new_master = optax.apply_updates(master, updates)
-            return new_master, new_opt_state
-
-        def skip_step(operand):
-            # Reference: patched skip_step clears grads and does nothing
-            # (handle.py:131-150).
-            return operand
+            return optax.apply_updates(master, updates), new_opt_state
 
         master, opt_state = jax.lax.cond(
-            overflow, skip_step, do_step,
+            skip, lambda op: op, do_step,
             (state.master_params, state.opt_state))
+        return AmpState(master, opt_state, state.scaler_states,
+                        state.step + 1)
 
-        scaler_states = tuple(
-            new_sstate if i == loss_id else s
-            for i, s in enumerate(state.scaler_states))
-        new_state = AmpState(master, opt_state, scaler_states, state.step + 1)
-        return new_state, {"overflow": overflow,
-                           "loss_scale": new_sstate.loss_scale}
+    def apply_gradients_multi(
+        self,
+        state: AmpState,
+        grads_list: Sequence[Any],
+        loss_ids: Optional[Sequence[int]] = None,
+        reduce_fn: Optional[Callable[[Any], Any]] = None,
+    ) -> Tuple[AmpState, dict]:
+        """One optimizer fed by several backward passes, each scaled by its
+        own (or a shared) loss scaler — the reference's ``num_losses`` /
+        ``loss_id`` machinery driven to completion in one call.
+
+        ``grads_list[i]`` is the (still-scaled) grad pytree of loss ``i``;
+        zeros where a loss does not touch a param (what ``.backward()``
+        accumulation leaves untouched in the reference).  Per backward:
+        unscale with scaler ``loss_ids[i]``, per-backward finite check,
+        per-scaler ``update_scale``; the unscaled grads sum into the master
+        grads and the step is skipped iff **any** backward overflowed
+        (each exit arms ``skip_step`` on the shared optimizer,
+        ``handle.py:131-150``).
+
+        With a shared scaler (repeated loss_id) all backwards here unscale
+        at the iteration-entry scale, while the reference re-scales later
+        losses after an earlier overflow halved the shared scaler
+        mid-iteration.  Scale and unscale cancel per backward, so master
+        grads — and every observable outcome — are identical.
+        """
+        if loss_ids is None:
+            loss_ids = list(range(len(grads_list)))
+        if len(loss_ids) != len(grads_list):
+            raise ValueError("loss_ids and grads_list length mismatch")
+
+        if not self.properties.enabled:
+            total = jax.tree.map(lambda *gs: sum(gs), *grads_list)
+            new_state, info = self.apply_gradients(state, total,
+                                                   reduce_fn=reduce_fn)
+            # Same metrics pytree shape as the enabled path below.
+            return new_state, {
+                "overflow": info["overflow"],
+                "loss_scale": tuple(jnp.asarray(1.0, jnp.float32)
+                                    for _ in new_state.scaler_states)}
+
+        # Callers scale every loss at iteration entry, so unscale against the
+        # entry-time scaler states even as the per-loss updates land below
+        # (scale/unscale must use the same value to cancel).
+        entry_state = state
+        total = None
+        any_overflow = None
+        for grads, lid in zip(grads_list, loss_ids):
+            if reduce_fn is not None:
+                grads = reduce_fn(grads)
+            unscaled, finite = self.unscale_gradients(entry_state, grads,
+                                                      loss_id=lid)
+            state, overflow = self.update_scaler(state, lid, finite)
+            total = unscaled if total is None else jax.tree.map(
+                jnp.add, total, unscaled)
+            any_overflow = overflow if any_overflow is None else \
+                jnp.logical_or(any_overflow, overflow)
+
+        new_state = self.step_if(state, total, any_overflow)
+        return new_state, {
+            "overflow": any_overflow,
+            "loss_scale": tuple(s.loss_scale
+                                for s in new_state.scaler_states),
+        }
 
 
 def initialize(
