@@ -229,6 +229,37 @@ def _linear(x, kernel, bias=None):
 
 linear = half_function(_linear)
 
+def _conv(x, kernel, bias=None, *, window_strides=None, padding="SAME",
+          dimension_numbers=None, **kw):
+    """``F.conv*`` spelling (functional_overrides.py:18-24): one N-D entry
+    point with an optional bias — dimensionality is carried by the operand
+    ranks, unlike torch's conv1d/2d/3d.  Defaults: stride 1, SAME padding,
+    channels-last (``NHWC``-style) dimension numbers, the TPU-native layout."""
+    spatial = x.ndim - 2
+    if window_strides is None:
+        window_strides = (1,) * spatial
+    if dimension_numbers is None:
+        chars = "DHW"[-spatial:] if spatial <= 3 else None
+        if chars is None:
+            raise ValueError("give dimension_numbers for >3 spatial dims")
+        dimension_numbers = (f"N{chars}C", f"{chars}IO", f"N{chars}C")
+    y = lax.conv_general_dilated(x, kernel, window_strides=window_strides,
+                                 padding=padding,
+                                 dimension_numbers=dimension_numbers, **kw)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+conv = half_function(_conv)
+
+
+def _prelu(x, alpha):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+prelu = half_function(_prelu)  # torch_overrides.py:7-26 FP16 list
+
 # FP32_OPS — numerically sensitive work cast to fp32.
 
 exp = float_function(jnp.exp)
@@ -265,6 +296,146 @@ def _norm(x, ord=None, axis=None, keepdims=False):
 
 norm = float_function(_norm)
 
+
+def _softmin(x, axis=-1):
+    return jax.nn.softmax(-x, axis=axis)
+
+
+softmin = float_function(_softmin)
+
+
+def _layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5):
+    """``F.layer_norm`` semantics: normalize over the trailing
+    ``len(normalized_shape)`` dims (functional_overrides.py:29-65; the fused
+    module lives in :mod:`apex_tpu.normalization`)."""
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    if weight is not None:
+        y = y * weight
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+layer_norm = float_function(_layer_norm)
+
+
+def _group_norm(x, num_groups, weight=None, bias=None, eps=1e-5):
+    """``F.group_norm`` with channels LAST (TPU-native layout; torch is
+    channels-first)."""
+    c = x.shape[-1]
+    if c % num_groups:
+        raise ValueError(f"channels {c} not divisible by groups {num_groups}")
+    shape = x.shape
+    g = x.reshape(shape[:-1] + (num_groups, c // num_groups))
+    axes = tuple(range(1, g.ndim - 2)) + (g.ndim - 1,)
+    mean = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.var(g, axis=axes, keepdims=True)
+    y = ((g - mean) * lax.rsqrt(var + eps)).reshape(shape)
+    if weight is not None:
+        y = y * weight
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+group_norm = float_function(_group_norm)
+
+
+def _batch_norm(x, running_mean, running_var, weight=None, bias=None,
+                training=False, eps=1e-5):
+    """``F.batch_norm`` normalization over the channels-last axis.  Pure
+    function: in training mode it normalizes with batch statistics; running
+    stats are carried by the caller (the stateful module is
+    :class:`apex_tpu.parallel.SyncBatchNorm`)."""
+    if training:
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+    else:
+        mean, var = running_mean, running_var
+    y = (x - mean) * lax.rsqrt(var + eps)
+    if weight is not None:
+        y = y * weight
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+batch_norm = float_function(_batch_norm)
+
+
+def _nll_loss(log_probs, targets):
+    picked = jnp.take_along_axis(log_probs, targets[..., None], axis=-1)
+    return -jnp.mean(picked)
+
+
+nll_loss = float_function(_nll_loss)
+
+
+def _cross_entropy(logits, targets):
+    return _nll_loss(jax.nn.log_softmax(logits, axis=-1), targets)
+
+
+cross_entropy = float_function(_cross_entropy)
+
+
+def _l1_loss(pred, target):
+    return jnp.mean(jnp.abs(pred - target))
+
+
+l1_loss = float_function(_l1_loss)
+
+
+def _mse_loss(pred, target):
+    return jnp.mean(jnp.square(pred - target))
+
+
+mse_loss = float_function(_mse_loss)
+
+
+def _smooth_l1_loss(pred, target, beta=1.0):
+    d = jnp.abs(pred - target)
+    return jnp.mean(jnp.where(d < beta, 0.5 * d * d / beta, d - 0.5 * beta))
+
+
+smooth_l1_loss = float_function(_smooth_l1_loss)
+
+
+def _kl_div(log_pred, target):
+    """``F.kl_div`` pointwise ``target * (log(target) - log_pred)``,
+    mean-reduced, with the 0·log0 = 0 convention."""
+    pointwise = jnp.where(target > 0,
+                          target * (jnp.log(jnp.maximum(target, 1e-38))
+                                    - log_pred),
+                          0.0)
+    return jnp.mean(pointwise)
+
+
+kl_div = float_function(_kl_div)
+
+
+def _poisson_nll_loss(log_input, target):
+    return jnp.mean(jnp.exp(log_input) - target * log_input)
+
+
+poisson_nll_loss = float_function(_poisson_nll_loss)
+
+
+def _cosine_embedding_loss(x1, x2, y, margin=0.0, eps=1e-8):
+    cos = jnp.sum(x1 * x2, axis=-1) * lax.rsqrt(
+        jnp.maximum(jnp.sum(x1 * x1, axis=-1) * jnp.sum(x2 * x2, axis=-1),
+                    eps * eps))
+    loss = jnp.where(y == 1, 1.0 - cos, jnp.maximum(0.0, cos - margin))
+    return jnp.mean(loss)
+
+
+cosine_embedding_loss = float_function(_cosine_embedding_loss)
+
 # PROMOTE_OPS — jnp binary promotion already picks the widest type; exported
 # wrapped anyway so user code routed through ops.* is policy-auditable.
 
@@ -275,6 +446,9 @@ div = promote_function(jnp.divide)
 atan2 = promote_function(jnp.arctan2)
 maximum = promote_function(jnp.maximum)
 minimum = promote_function(jnp.minimum)
+equal = promote_function(jnp.equal)
+greater = promote_function(jnp.greater)
+less = promote_function(jnp.less)
 
 # SEQUENCE_PROMOTE_OPS (reference wrap.sequence_promote, wrap.py:71-90)
 

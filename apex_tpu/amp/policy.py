@@ -169,14 +169,14 @@ def resolve(opt_level: str = "O1",
     # The reference accepts cast_model_type=False as an explicit "do not cast
     # the model" override on top of O2/O3 (frontend.py:334-347; used heavily
     # by tests/L0/run_amp/test_multiple_models_optimizers_losses.py).
-    if overrides.get("cast_model_dtype") is False:
-        overrides["cast_model_dtype"] = None
-        overrides.setdefault("keep_batchnorm_fp32", None)  # moot w/o a cast
-        # dataclasses.replace skips None-valued fields only via our filter
-        # above, so force these two through explicitly.
-        props = props.replace(cast_model_dtype=None,
-                              keep_batchnorm_fp32=overrides.pop(
-                                  "keep_batchnorm_fp32"))
-        overrides.pop("cast_model_dtype")
+    cast_override = overrides.pop("cast_model_dtype", None)
+    if cast_override is False:
+        # Force both through explicitly (the None-filter above would
+        # otherwise treat them as "keep the opt level's default").
+        props = props.replace(
+            cast_model_dtype=None,
+            keep_batchnorm_fp32=overrides.pop("keep_batchnorm_fp32", None))
+    elif cast_override is not None:
+        overrides["cast_model_dtype"] = cast_override
     props = props.replace(enabled=enabled, **overrides)
     return props

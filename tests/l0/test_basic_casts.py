@@ -1,0 +1,239 @@
+"""Casting-policy conformance: the port of the reference's
+``tests/L0/run_amp/test_basic_casts.py`` (+ ``utils.py`` fixtures).
+
+The reference's ``run_layer_test`` asserts the *output dtype string* of every
+patched fn for fp16/fp32/fp64 inputs: whitelist -> HalfTensor, blacklist ->
+FloatTensor, promote/passthrough -> match-the-widest-input, banned BCE raises
+unless allowed (:14-21, 73-103).  Here the policy layer is
+:mod:`apex_tpu.amp.ops`; the same matrix is asserted for every entry of the
+:mod:`apex_tpu.amp.lists` tables, plus a table-integrity check that each
+listed name actually exists in the ops namespace with the right wrapper kind
+(the reference's auto-append consistency, ``tensor_overrides.py:55-62``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.amp import lists, ops
+
+HALF = jnp.bfloat16
+O1 = amp.O1(half_dtype=HALF)
+
+
+def r(*shape, dtype=jnp.float32, key=0):
+    return jax.random.normal(jax.random.PRNGKey(key), shape).astype(dtype)
+
+
+# Representative invocation per op: fn(dtype) -> output array.  Ops taking
+# several floating args get them all at the probe dtype (the reference casts
+# every input the same way, utils.py:1-21).
+B, N, C = 4, 8, 16
+
+HALF_CALLS = {
+    "matmul": lambda dt: ops.matmul(r(B, N, dtype=dt), r(N, C, dtype=dt)),
+    "dot": lambda dt: ops.dot(r(N, dtype=dt), r(N, dtype=dt)),
+    "tensordot": lambda dt: ops.tensordot(r(B, N, dtype=dt),
+                                          r(N, C, dtype=dt), 1),
+    "einsum": lambda dt: ops.einsum("bn,nc->bc", r(B, N, dtype=dt),
+                                    r(N, C, dtype=dt)),
+    "dot_general": lambda dt: ops.dot_general(
+        r(B, N, dtype=dt), r(N, C, dtype=dt),
+        dimension_numbers=(((1,), (0,)), ((), ()))),
+    "conv": lambda dt: ops.conv(
+        r(1, 8, 8, 3, dtype=dt), r(3, 3, 3, C, dtype=dt),
+        window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC")),
+    "conv_general_dilated": lambda dt: ops.conv_general_dilated(
+        r(1, 8, 8, 3, dtype=dt), r(3, 3, 3, C, dtype=dt),
+        window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC")),
+    "conv_transpose": lambda dt: ops.conv_transpose(
+        r(1, 8, 8, 3, dtype=dt), r(3, 3, 3, C, dtype=dt),
+        strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC")),
+    "linear": lambda dt: ops.linear(r(B, N, dtype=dt), r(N, C, dtype=dt),
+                                    r(C, dtype=dt)),
+    "prelu": lambda dt: ops.prelu(r(B, N, dtype=dt),
+                                  jnp.asarray(0.25, dt)),
+}
+
+FP32_CALLS = {
+    "exp": lambda dt: ops.exp(r(N, dtype=dt)),
+    "expm1": lambda dt: ops.expm1(r(N, dtype=dt)),
+    "log": lambda dt: ops.log(jnp.abs(r(N, dtype=dt)) + 0.5),
+    "log1p": lambda dt: ops.log1p(jnp.abs(r(N, dtype=dt))),
+    "log2": lambda dt: ops.log2(jnp.abs(r(N, dtype=dt)) + 0.5),
+    "log10": lambda dt: ops.log10(jnp.abs(r(N, dtype=dt)) + 0.5),
+    "pow": lambda dt: ops.pow(jnp.abs(r(N, dtype=dt)) + 0.5, 2.0),
+    "reciprocal": lambda dt: ops.reciprocal(r(N, dtype=dt) + 2.0),
+    "rsqrt": lambda dt: ops.rsqrt(jnp.abs(r(N, dtype=dt)) + 0.5),
+    "sinh": lambda dt: ops.sinh(r(N, dtype=dt)),
+    "cosh": lambda dt: ops.cosh(r(N, dtype=dt)),
+    "tan": lambda dt: ops.tan(r(N, dtype=dt)),
+    "acos": lambda dt: ops.acos(jnp.clip(r(N, dtype=dt), -0.9, 0.9)),
+    "asin": lambda dt: ops.asin(jnp.clip(r(N, dtype=dt), -0.9, 0.9)),
+    "erfinv": lambda dt: ops.erfinv(jnp.clip(r(N, dtype=dt), -0.9, 0.9)),
+    "sum": lambda dt: ops.sum(r(N, dtype=dt)),
+    "prod": lambda dt: ops.prod(r(N, dtype=dt)),
+    "mean": lambda dt: ops.mean(r(N, dtype=dt)),
+    "var": lambda dt: ops.var(r(N, dtype=dt)),
+    "std": lambda dt: ops.std(r(N, dtype=dt)),
+    "cumsum": lambda dt: ops.cumsum(r(N, dtype=dt)),
+    "cumprod": lambda dt: ops.cumprod(r(N, dtype=dt)),
+    "norm": lambda dt: ops.norm(r(N, dtype=dt)),
+    "logsumexp": lambda dt: ops.logsumexp(r(N, dtype=dt)),
+    "softmax": lambda dt: ops.softmax(r(B, N, dtype=dt)),
+    "log_softmax": lambda dt: ops.log_softmax(r(B, N, dtype=dt)),
+    "softmin": lambda dt: ops.softmin(r(B, N, dtype=dt)),
+    "softplus": lambda dt: ops.softplus(r(N, dtype=dt)),
+    "layer_norm": lambda dt: ops.layer_norm(r(B, N, dtype=dt), N,
+                                            r(N, dtype=dt, key=1),
+                                            r(N, dtype=dt, key=2)),
+    "group_norm": lambda dt: ops.group_norm(r(B, C, dtype=dt), 4,
+                                            r(C, dtype=dt, key=1),
+                                            r(C, dtype=dt, key=2)),
+    "batch_norm": lambda dt: ops.batch_norm(
+        r(B, C, dtype=dt), jnp.zeros(C, dt), jnp.ones(C, dt),
+        r(C, dtype=dt, key=1), r(C, dtype=dt, key=2)),
+    "cross_entropy": lambda dt: ops.cross_entropy(
+        r(B, N, dtype=dt), jnp.arange(B) % N),
+    "nll_loss": lambda dt: ops.nll_loss(
+        jax.nn.log_softmax(r(B, N, dtype=dt)), jnp.arange(B) % N),
+    "l1_loss": lambda dt: ops.l1_loss(r(N, dtype=dt), r(N, dtype=dt, key=1)),
+    "mse_loss": lambda dt: ops.mse_loss(r(N, dtype=dt),
+                                        r(N, dtype=dt, key=1)),
+    "smooth_l1_loss": lambda dt: ops.smooth_l1_loss(
+        r(N, dtype=dt), r(N, dtype=dt, key=1)),
+    "kl_div": lambda dt: ops.kl_div(
+        jax.nn.log_softmax(r(B, N, dtype=dt)),
+        jax.nn.softmax(r(B, N, dtype=dt, key=1))),
+    "poisson_nll_loss": lambda dt: ops.poisson_nll_loss(
+        r(N, dtype=dt), jnp.abs(r(N, dtype=dt, key=1))),
+    "cosine_embedding_loss": lambda dt: ops.cosine_embedding_loss(
+        r(B, N, dtype=dt), r(B, N, dtype=dt, key=1),
+        jnp.ones(B, jnp.int32)),
+}
+
+PROMOTE_CALLS = {
+    "add": lambda a, b: ops.add(a, b),
+    "sub": lambda a, b: ops.sub(a, b),
+    "mul": lambda a, b: ops.mul(a, b),
+    "div": lambda a, b: ops.div(a, b + 2.0),
+    "atan2": lambda a, b: ops.atan2(a, b + 2.0),
+    "maximum": lambda a, b: ops.maximum(a, b),
+    "minimum": lambda a, b: ops.minimum(a, b),
+    "equal": lambda a, b: ops.equal(a, b),
+    "greater": lambda a, b: ops.greater(a, b),
+    "less": lambda a, b: ops.less(a, b),
+}
+
+COMPARISONS = {"equal", "greater", "less"}
+
+
+def test_lists_and_ops_namespace_agree():
+    """Table integrity: every listed name exists in the ops namespace with the
+    wrapper kind its table prescribes (the reference's auto-append rule,
+    ``tensor_overrides.py:55-62``, made an explicit invariant)."""
+    for name in lists.HALF_OPS:
+        assert getattr(ops, name).__amp_wrapped__ == "half", name
+    for name in lists.FP32_OPS:
+        assert getattr(ops, name).__amp_wrapped__ == "float", name
+    for name in lists.PROMOTE_OPS:
+        assert getattr(ops, name).__amp_wrapped__ == "promote", name
+    for name in lists.SEQUENCE_PROMOTE_OPS:
+        assert getattr(ops, name).__amp_wrapped__ == "sequence_promote", name
+    for name in lists.BANNED_OPS:
+        assert getattr(ops, name).__amp_wrapped__ == "banned", name
+    # and the calls tables above cover the lists completely
+    assert set(HALF_CALLS) == set(lists.HALF_OPS)
+    assert set(FP32_CALLS) == set(lists.FP32_OPS)
+    assert set(PROMOTE_CALLS) == set(lists.PROMOTE_OPS)
+
+
+@pytest.mark.parametrize("name", sorted(HALF_CALLS))
+@pytest.mark.parametrize("in_dtype", [jnp.float32, HALF])
+def test_whitelist_to_half(name, in_dtype):
+    """Whitelist fn x any float input -> half output (reference :73-79)."""
+    with ops.cast_context(O1):
+        out = HALF_CALLS[name](in_dtype)
+    assert out.dtype == HALF, (name, out.dtype)
+
+
+@pytest.mark.parametrize("name", sorted(FP32_CALLS))
+@pytest.mark.parametrize("in_dtype", [jnp.float32, HALF])
+def test_blacklist_to_float(name, in_dtype):
+    """Blacklist fn x any float input -> fp32 output (reference :81-87)."""
+    with ops.cast_context(O1):
+        out = FP32_CALLS[name](in_dtype)
+    assert out.dtype == jnp.float32, (name, out.dtype)
+
+
+@pytest.mark.parametrize("name", sorted(PROMOTE_CALLS))
+@pytest.mark.parametrize("dtypes", [(HALF, HALF), (jnp.float32, HALF),
+                                    (HALF, jnp.float32),
+                                    (jnp.float32, jnp.float32)])
+def test_promote_widest(name, dtypes):
+    """Promote fn -> widest input type; comparisons -> bool
+    (reference test_promotion.py:12-42 covers the op set via CASTS)."""
+    a, b = r(N, dtype=dtypes[0]), r(N, dtype=dtypes[1], key=1)
+    with ops.cast_context(O1):
+        out = PROMOTE_CALLS[name](a, b)
+    if name in COMPARISONS:
+        assert out.dtype == jnp.bool_
+    else:
+        expect = jnp.float32 if jnp.float32 in dtypes else HALF
+        assert out.dtype == expect, (name, out.dtype)
+
+
+@pytest.mark.parametrize("name", ["concatenate", "stack"])
+def test_sequence_promote(name):
+    fn = getattr(ops, name)
+    with ops.cast_context(O1):
+        out = fn([r(N, dtype=HALF), r(N, dtype=jnp.float32, key=1)])
+        assert out.dtype == jnp.float32
+        out = fn([r(N, dtype=HALF), r(N, dtype=HALF, key=1)])
+        assert out.dtype == HALF
+
+
+def test_passthrough_without_policy():
+    """No active policy -> every op is a transparent passthrough
+    (reference: unpatched torch behaves normally)."""
+    x = r(B, N, dtype=jnp.float32)
+    w = r(N, C, dtype=jnp.float32)
+    assert ops.matmul(x, w).dtype == jnp.float32
+    assert ops.softmax(x.astype(HALF)).dtype == HALF
+    np.testing.assert_allclose(np.asarray(ops.matmul(x, w)),
+                               np.asarray(jnp.matmul(x, w)), rtol=1e-6)
+
+
+def test_banned_bce_raises_and_allow_banned():
+    """BCE on probabilities raises on half input under the policy with the
+    detailed message; fp32 inputs and disabled casts pass (reference
+    :89-103, functional_overrides.py:67-77)."""
+    probs = jnp.clip(jnp.abs(r(N, dtype=HALF)), 0.05, 0.95)
+    targets = (r(N, dtype=jnp.float32, key=1) > 0).astype(jnp.float32)
+    with ops.cast_context(O1):
+        with pytest.raises(NotImplementedError, match="binary_cross_entropy"):
+            ops.binary_cross_entropy(probs, targets)
+        # fp32 inputs are allowed
+        out = ops.binary_cross_entropy(probs.astype(jnp.float32), targets)
+        assert out.dtype == jnp.float32
+        # and disable_casts suspends the ban (reference handle.disable_casts)
+        with ops.disable_casts():
+            ops.binary_cross_entropy(probs, targets)
+
+
+def test_half_values_match_fp32_reference():
+    """Numerics sanity on top of the dtype matrix: the O1-cast matmul equals
+    the fp32 matmul of pre-cast inputs (what the reference's cast cache
+    test guards, test_cache.py:15-21 — grads/values must match an uncached
+    reference; XLA CSE plays the cache's role here)."""
+    x, w = r(B, N), r(N, C, key=1)
+    with ops.cast_context(O1):
+        y = ops.matmul(x, w)
+    y_ref = jnp.matmul(x.astype(HALF), w.astype(HALF))
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(y_ref, np.float32))

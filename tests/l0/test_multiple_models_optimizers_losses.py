@@ -98,7 +98,7 @@ def inject_inf_into(grads, model_key, loc):
     return grads
 
 
-def case_grid(opt_level, use_multiple_loss_scalers, which_backwards=(0, 1),
+def case_grid(opt_level, which_backwards=(0, 1),
               which_models_by_backward=None):
     """The inject-inf grid of the reference: O1/O2 (dynamic-scaler levels)
     also run with an inf planted at iteration {0,1} x loc x backward
@@ -216,7 +216,7 @@ def _run_one_optimizer_case(n_models, opt_level, use_multiple_loss_scalers,
 @pytest.mark.parametrize("use_multiple_loss_scalers", (True, False))
 @pytest.mark.parametrize("opt_level", OPT_LEVELS)
 def test_2models2losses1optimizer(opt_level, use_multiple_loss_scalers):
-    for case in case_grid(opt_level, use_multiple_loss_scalers):
+    for case in case_grid(opt_level):
         _run_one_optimizer_case(2, opt_level, use_multiple_loss_scalers, case)
 
 
@@ -225,7 +225,7 @@ def test_2models2losses1optimizer(opt_level, use_multiple_loss_scalers):
 def test_3models2losses1optimizer(opt_level, use_multiple_loss_scalers):
     # which_model: backward 0 spans models {0,2}; backward 1 spans {1,2}
     # (reference :227-233).
-    for case in case_grid(opt_level, use_multiple_loss_scalers,
+    for case in case_grid(opt_level,
                           which_models_by_backward={0: (0, 2), 1: (1, 2)}):
         _run_one_optimizer_case(3, opt_level, use_multiple_loss_scalers, case)
 
@@ -240,7 +240,7 @@ def test_2models2losses2optimizers(opt_level, use_multiple_loss_scalers):
     num_losses = 2 if use_multiple_loss_scalers else 1
     loss_ids = [0, 1] if use_multiple_loss_scalers else [0, 0]
 
-    def run_reference(iters, skip):
+    def run_reference(iters, skip, skip_pairs):
         """fp32 run replaying the expected skip pattern
         (what_got_skipped variants, reference :358-404)."""
         p0 = reference_dtype_params({"m0": make_model(1)}, opt_level)
@@ -262,14 +262,14 @@ def test_2models2losses2optimizers(opt_level, use_multiple_loss_scalers):
                 p1 = optax.apply_updates(p1, u)
         return grads_seen, (p0, p1)
 
-    for case in case_grid(opt_level, use_multiple_loss_scalers):
+    for case in case_grid(opt_level):
         inject, wb = case["inject_inf"], case["which_backward"]
         iters = 3 if inject >= 0 else 2
         # overflow in backward j skips optimizer j only (scale_loss binds
         # one optimizer per context here, reference :446-449).
         skip_pairs = {(inject, wb)} if inject >= 0 else set()
         skip = {inject} if inject >= 0 else set()
-        ref_grads, (ref_p0, ref_p1) = run_reference(iters, skip)
+        ref_grads, (ref_p0, ref_p1) = run_reference(iters, skip, skip_pairs)
 
         tx0 = sgd_by_group({"m0": 0.25}, momentum=0.125)
         tx1 = sgd_by_group({"m1": 0.5}, momentum=0.25)
@@ -357,7 +357,7 @@ def test_3models2losses2optimizers(opt_level, use_multiple_loss_scalers):
                 p1 = optax.apply_updates(p1, u)
         return grads_seen, (p0, p1)
 
-    for case in case_grid(opt_level, use_multiple_loss_scalers,
+    for case in case_grid(opt_level,
                           which_models_by_backward={0: (0, 1), 1: (2, 1)}):
         inject, wb, wm = (case["inject_inf"], case["which_backward"],
                           case["which_model"])
