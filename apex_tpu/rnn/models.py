@@ -60,7 +60,7 @@ class RNNLayer(nn.Module):
         return p
 
     @nn.compact
-    def __call__(self, xs: jax.Array, init_state=None):
+    def __call__(self, xs: jax.Array, init_state=None, seq_lengths=None):
         from apex_tpu.amp import ops as amp_ops
         # Under an active O1 policy the whole recurrence runs at the half
         # dtype (the rnn_cast capability, wrap.py:157-265): cast inputs and
@@ -85,7 +85,7 @@ class RNNLayer(nn.Module):
                 init_state = jnp.zeros((batch, out_size), xs.dtype)
         cell = C.CELLS[self.mode]
 
-        def step(state, x_t):
+        def cell_step(state, x_t):
             new_state, out = cell(params, x_t, state)
             if self.output_size is not None:
                 # project h before it re-enters the recurrence
@@ -97,7 +97,34 @@ class RNNLayer(nn.Module):
                     new_state = out
             return new_state, out
 
-        final, ys = jax.lax.scan(step, init_state, xs, reverse=self.reverse)
+        if seq_lengths is None:
+            final, ys = jax.lax.scan(cell_step, init_state, xs,
+                                     reverse=self.reverse)
+            return ys, final
+
+        # Variable-length sequences: the TPU-native analog of torch's
+        # PackedSequence (reference test exercises pack_padded_sequence
+        # through the cast-patched cuDNN path, tests/L0/run_amp/
+        # test_rnn.py:104-116).  cuDNN packs to skip padded work; under
+        # XLA static shapes the idiom is padded batches + a validity mask
+        # inside the scan: padded steps carry the state through unchanged
+        # and emit zero outputs, so the final state is the state at
+        # t = length-1 and padded output rows are zeros, exactly the
+        # semantics pad_packed_sequence reconstructs.
+        t_idx = jnp.arange(xs.shape[0], dtype=jnp.int32)
+        valid = t_idx[:, None] < seq_lengths[None, :].astype(jnp.int32)
+
+        def masked_step(state, inp):
+            x_t, valid_t = inp
+            new_state, out = cell_step(state, x_t)
+            m = valid_t[:, None]
+            new_state = jax.tree.map(
+                lambda n, o: jnp.where(m, n, o), new_state, state)
+            out = jnp.where(m, out, jnp.zeros_like(out))
+            return new_state, out
+
+        final, ys = jax.lax.scan(masked_step, init_state, (xs, valid),
+                                 reverse=self.reverse)
         return ys, final
 
 
@@ -118,7 +145,7 @@ class RNN(nn.Module):
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, xs: jax.Array, init_states=None):
+    def __call__(self, xs: jax.Array, init_states=None, seq_lengths=None):
         finals = []
         h = xs
         for layer in range(self.num_layers):
@@ -133,12 +160,12 @@ class RNN(nn.Module):
                                reverse=True, param_dtype=self.param_dtype,
                                name=f"layer_{layer}_bwd")
                 init_f, init_b = (None, None) if init is None else init
-                ys_f, fin_f = fwd(h, init_f)
-                ys_b, fin_b = bwd(h, init_b)
+                ys_f, fin_f = fwd(h, init_f, seq_lengths)
+                ys_b, fin_b = bwd(h, init_b, seq_lengths)
                 h = jnp.concatenate([ys_f, ys_b], axis=-1)
                 finals.append((fin_f, fin_b))
             else:
-                h, fin = fwd(h, init)
+                h, fin = fwd(h, init, seq_lengths)
                 finals.append(fin)
         return h, finals
 

@@ -116,3 +116,66 @@ def test_initial_state_passthrough():
     ys, finals = model.apply(params, x, [h0])
     ys_zero, _ = model.apply(params, x)
     assert not np.allclose(np.asarray(ys[0]), np.asarray(ys_zero[0]))
+
+
+@pytest.mark.parametrize("mode", ["tanh", "gru", "lstm"])
+def test_variable_length_matches_per_sequence(mode):
+    """The PackedSequence analog (reference test_rnn.py:104-116): a padded
+    batch with seq_lengths must match running each sequence unpadded, with
+    zero outputs in the padded region and final state at t = length-1."""
+    model = apex_rnn.RNN(mode=mode, hidden_size=H)
+    x = data()
+    lengths = jnp.asarray([T, 3, 1], jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    ys, finals = model.apply(params, x, seq_lengths=lengths)
+    assert ys.shape == (T, B, H)
+    for b in range(B):
+        L = int(lengths[b])
+        ys_b, fin_b = model.apply(params, x[:L, b:b + 1, :])
+        np.testing.assert_allclose(np.asarray(ys[:L, b]),
+                                   np.asarray(ys_b[:, 0]),
+                                   rtol=1e-5, atol=1e-6)
+        # padded region is zero
+        np.testing.assert_array_equal(np.asarray(ys[L:, b]), 0.0)
+        # final state matches the unpadded run's final state
+        fin_full = jax.tree.leaves(finals[0])
+        fin_solo = jax.tree.leaves(fin_b[0])
+        for lf, ls in zip(fin_full, fin_solo):
+            np.testing.assert_allclose(np.asarray(lf[b]), np.asarray(ls[0]),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_variable_length_bidirectional():
+    """Reverse direction processes x[L-1]..x[0] per sequence — the padded
+    tail contributes nothing (pad_packed_sequence semantics)."""
+    model = apex_rnn.RNN(mode="gru", hidden_size=H, bidirectional=True)
+    x = data()
+    lengths = jnp.asarray([T, 3, 2], jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    ys, _ = model.apply(params, x, seq_lengths=lengths)
+    assert ys.shape == (T, B, 2 * H)
+    for b in range(B):
+        L = int(lengths[b])
+        ys_b, _ = model.apply(params, x[:L, b:b + 1, :])
+        np.testing.assert_allclose(np.asarray(ys[:L, b]),
+                                   np.asarray(ys_b[:, 0]),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(ys[L:, b]), 0.0)
+
+
+def test_variable_length_grads_flow_only_through_valid_steps():
+    model = apex_rnn.RNN(mode="lstm", hidden_size=H)
+    x = data()
+    lengths = jnp.asarray([T, 3, 1], jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x)
+
+    def loss(xin):
+        ys, _ = model.apply(params, xin, seq_lengths=lengths)
+        return jnp.sum(ys ** 2)
+
+    gx = jax.grad(loss)(x)
+    # no gradient reaches padded inputs
+    for b in range(B):
+        L = int(lengths[b])
+        np.testing.assert_array_equal(np.asarray(gx[L:, b]), 0.0)
+        assert float(jnp.abs(gx[:L, b]).max()) > 0
