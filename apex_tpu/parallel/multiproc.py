@@ -3,14 +3,27 @@
 Port of ``apex/parallel/multiproc.py:1-35`` (the one-process-per-GPU
 spawner).  On TPU the launch model is one process per *host*, each seeing its
 local chips, coordinated by ``jax.distributed.initialize`` — there is nothing
-to spawn per chip.  This module provides the initialization wrapper plus the
-reference's env-var conventions.
+to spawn per chip on a Cloud TPU VM.  This module provides:
+
+- :func:`initialize` — the per-process entry (``jax.distributed``
+  wrapper honoring the reference's env-var contract);
+- :func:`spawn` / ``python -m apex_tpu.parallel.multiproc script.py …`` —
+  the reference's local spawner, for multi-process runs on one machine
+  (e.g. N CPU-backend processes, or one process per local accelerator
+  runtime).  Matching the reference: rank 0 inherits stdout, every other
+  rank logs to ``PROC_<i>.log`` (the reference's ``GPU_<i>.log``,
+  ``multiproc.py:30``), ``--world-size``/``--rank`` style overrides via
+  ``WORLD_SIZE``, and the launcher waits for all workers.  Unlike the
+  reference it also exports ``COORDINATOR_ADDRESS``/``WORLD_SIZE``/``RANK``
+  so the spawned script just calls :func:`initialize`.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Optional
+import subprocess
+import sys
+from typing import List, Optional, Sequence
 
 import jax
 
@@ -37,3 +50,80 @@ def initialize(coordinator_address: Optional[str] = None,
     if rank is not None:
         kwargs["process_id"] = int(rank)
     jax.distributed.initialize(**kwargs)
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def spawn(argslist: Sequence[str], world_size: Optional[int] = None,
+          coordinator_port: Optional[int] = None,
+          log_prefix: str = "PROC_") -> List[int]:
+    """Launch ``world_size`` copies of ``python argslist...`` with the
+    distributed env set, wait for all, and return their exit codes
+    (reference ``multiproc.py:22-35``).
+
+    ``world_size`` defaults to ``WORLD_SIZE`` in the environment; it must
+    be given one way or the other (the reference defaulted to the local
+    GPU count, but enumerating devices here would initialize the JAX
+    runtime *in the launcher* and wedge the accelerator before the
+    workers fork).  ``coordinator_port`` defaults to ``COORDINATOR_PORT``
+    in the environment, else a freshly bound free port, so concurrent
+    spawns on one machine cannot collide.
+
+    Workers are terminated (and log files closed) if the launcher is
+    interrupted or a launch step fails, so no orphans linger waiting for
+    the rest of the cluster.
+    """
+    argslist = list(argslist)
+    if world_size is None:
+        ws_env = os.environ.get("WORLD_SIZE")
+        if not ws_env:
+            raise ValueError(
+                "spawn() needs world_size= or the WORLD_SIZE env var "
+                "(not derived from the device count: that would "
+                "initialize the JAX runtime inside the launcher)")
+        world_size = int(ws_env)
+    if coordinator_port is None:
+        coordinator_port = int(os.environ.get("COORDINATOR_PORT")
+                               or _free_port())
+
+    workers: List[subprocess.Popen] = []
+    logs = []
+    try:
+        for i in range(world_size):
+            env = dict(os.environ,
+                       COORDINATOR_ADDRESS=f"localhost:{coordinator_port}",
+                       WORLD_SIZE=str(world_size), RANK=str(i))
+            # rank 0 inherits stdout; others log to files (multiproc.py:30)
+            stdout = None
+            if i != 0:
+                stdout = open(f"{log_prefix}{i}.log", "w")
+                logs.append(stdout)
+            workers.append(subprocess.Popen([sys.executable] + argslist,
+                                            stdout=stdout, env=env))
+        return [p.wait() for p in workers]
+    finally:
+        for p in workers:
+            if p.poll() is None:
+                p.terminate()
+        for f in logs:
+            f.close()
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print("usage: python -m apex_tpu.parallel.multiproc script.py ...",
+              file=sys.stderr)
+        return 2
+    codes = spawn(argv)
+    # a signal-killed worker has a negative returncode; never mask it
+    return 0 if all(c == 0 for c in codes) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
