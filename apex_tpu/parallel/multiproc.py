@@ -47,8 +47,8 @@ def initialize(coordinator_address: Optional[str] = None,
     if ws:
         kwargs["num_processes"] = int(ws)
     rank = process_id if process_id is not None else os.environ.get("RANK")
-    if rank is not None:
-        kwargs["process_id"] = int(rank)
+    if rank is not None and rank != "":  # RANK="" falls through to
+        kwargs["process_id"] = int(rank)  # auto-detection like the others
     jax.distributed.initialize(**kwargs)
 
 
@@ -71,12 +71,14 @@ def spawn(argslist: Sequence[str], world_size: Optional[int] = None,
     GPU count, but enumerating devices here would initialize the JAX
     runtime *in the launcher* and wedge the accelerator before the
     workers fork).  ``coordinator_port`` defaults to ``COORDINATOR_PORT``
-    in the environment, else a freshly bound free port, so concurrent
-    spawns on one machine cannot collide.
+    in the environment, else a freshly bound free port — which makes a
+    collision between concurrent spawns on one machine unlikely (not
+    impossible: the port is released before the coordinator re-binds it).
 
-    Workers are terminated (and log files closed) if the launcher is
-    interrupted or a launch step fails, so no orphans linger waiting for
-    the rest of the cluster.
+    If any worker exits non-zero, the remaining workers are terminated
+    rather than left blocking on cluster formation; the same cleanup
+    (terminate, reap, close logs) runs if the launcher is interrupted or
+    a launch step fails.
     """
     argslist = list(argslist)
     if world_size is None:
@@ -105,11 +107,31 @@ def spawn(argslist: Sequence[str], world_size: Optional[int] = None,
                 logs.append(stdout)
             workers.append(subprocess.Popen([sys.executable] + argslist,
                                             stdout=stdout, env=env))
-        return [p.wait() for p in workers]
+        # Poll rather than wait sequentially: a crashed rank would leave
+        # the rest of the cluster blocked in jax.distributed.initialize
+        # waiting for it — fail fast and tear the others down instead.
+        import time
+        while True:
+            codes = [p.poll() for p in workers]
+            if all(c is not None for c in codes):
+                return codes
+            if any(c not in (None, 0) for c in codes):
+                for p in workers:
+                    if p.poll() is None:
+                        p.terminate()
+                return [p.wait() for p in workers]
+            time.sleep(0.2)
     finally:
         for p in workers:
             if p.poll() is None:
                 p.terminate()
+        for p in workers:  # reap: no zombies in a long-lived parent
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
         for f in logs:
             f.close()
 

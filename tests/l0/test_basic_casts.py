@@ -237,3 +237,44 @@ def test_half_values_match_fp32_reference():
     y_ref = jnp.matmul(x.astype(HALF), w.astype(HALF))
     np.testing.assert_allclose(np.asarray(y, np.float32),
                                np.asarray(y_ref, np.float32))
+
+
+@pytest.mark.parametrize("kind", ["half", "float", "promote"])
+def test_train_eval_train_transitions_keep_grads_stable(kind):
+    """Port of the cast-cache suite (``test_cache.py:31-96``): grads through
+    a whitelist/blacklist/promote module must be identical across
+    train -> eval -> train transitions and must match the explicitly
+    pre-cast reference (the property the reference's cache-invalidation
+    rules protect; here the policy layer is stateless and XLA CSE plays
+    the cache's role, so the invariant is structural)."""
+    w = r(N, C)
+    x = r(B, N, key=1)
+
+    def fwd(w):
+        if kind == "half":
+            y = ops.matmul(x, w)
+        elif kind == "float":
+            y = ops.softmax(ops.matmul(x, w))
+        else:
+            y = ops.add(jnp.matmul(x.astype(HALF), w.astype(HALF)),
+                        jnp.float32(1.0))
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    grads = []
+    for phase in ("train", "eval", "train"):
+        if phase == "train":
+            with ops.cast_context(O1):
+                grads.append(jax.grad(fwd)(w))
+        else:
+            fwd(w)  # eval pass outside the policy must not perturb anything
+
+    np.testing.assert_array_equal(np.asarray(grads[0], np.float32),
+                                  np.asarray(grads[1], np.float32))
+
+    # explicit-cast reference for the whitelist module (test_cache.py:15-21)
+    if kind == "half":
+        ref = jax.grad(lambda w: jnp.sum(
+            jnp.matmul(x.astype(HALF), w.astype(HALF))
+            .astype(jnp.float32) ** 2))(w)
+        np.testing.assert_array_equal(np.asarray(grads[0], np.float32),
+                                      np.asarray(ref, np.float32))
