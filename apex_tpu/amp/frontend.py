@@ -232,6 +232,7 @@ class Amp:
         loss_id: int = 0,
         stashed_grads: Optional[Any] = None,
         reduce_fn: Optional[Callable[[Any], Any]] = None,
+        finite_axes: Optional[Sequence[str]] = None,
     ) -> Tuple[AmpState, dict]:
         """Unscale → finite-check → scaler update → conditionally step.
 
@@ -248,6 +249,14 @@ class Amp:
         combination reproduces the reference's shared overflow buffer
         (which accumulates across every unscale of the iteration) with no
         caller cooperation.
+
+        ``finite_axes`` names mesh axes over which params (and so grads)
+        are *sharded* — pipeline stages over "pipe", experts over
+        "expert", tensor-parallel shards.  The finite flag is AND-reduced
+        over them so an overflow on any rank skips the step on every
+        rank, keeping the skip decision (and the scaler trajectory)
+        globally consistent.  DDP's replicated params don't need this:
+        the reduced grads are identical everywhere.
 
         Returns ``(new_state, info)`` with ``info = {"overflow", "loss_scale"}``
         — both device arrays; nothing here syncs to the host.
@@ -276,6 +285,9 @@ class Amp:
             finite = scaler_lib.all_finite(grads_unscaled)
         else:
             grads_unscaled, finite = self.scaler.unscale(grads, sstate)
+        for ax in (finite_axes or ()):
+            # AND across ranks sharing the step decision (min of {0,1})
+            finite = jax.lax.pmin(finite.astype(jnp.int32), ax).astype(bool)
         state, overflow = self.update_scaler(state, loss_id, finite)
         new_state = self.step_if(state, grads_unscaled, overflow)
         return new_state, {
@@ -345,6 +357,7 @@ class Amp:
         grads_list: Sequence[Any],
         loss_ids: Optional[Sequence[int]] = None,
         reduce_fn: Optional[Callable[[Any], Any]] = None,
+        finite_axes: Optional[Sequence[str]] = None,
     ) -> Tuple[AmpState, dict]:
         """One optimizer fed by several backward passes, each scaled by its
         own (or a shared) loss scaler — the reference's ``num_losses`` /
@@ -364,6 +377,11 @@ class Amp:
         losses after an earlier overflow halved the shared scaler
         mid-iteration.  Scale and unscale cancel per backward, so master
         grads — and every observable outcome — are identical.
+
+        ``finite_axes``: as in :meth:`apply_gradients` — each backward's
+        finite flag is AND-reduced over the param-sharding mesh axes so
+        skip decisions and per-loss scaler trajectories stay globally
+        consistent.
         """
         if loss_ids is None:
             loss_ids = list(range(len(grads_list)))
@@ -391,6 +409,9 @@ class Amp:
                 grads = reduce_fn(grads)
             unscaled, finite = self.unscale_gradients(entry_state, grads,
                                                       loss_id=lid)
+            for ax in (finite_axes or ()):
+                finite = jax.lax.pmin(finite.astype(jnp.int32),
+                                      ax).astype(bool)
             state, overflow = self.update_scaler(state, lid, finite)
             total = unscaled if total is None else jax.tree.map(
                 jnp.add, total, unscaled)
@@ -461,6 +482,7 @@ def make_train_step(
     axis_name: Optional[str] = None,
     reduce_fn: Optional[Callable[[Any], Any]] = None,
     has_aux: bool = False,
+    finite_axes: Optional[Sequence[str]] = None,
 ):
     """Build a jittable single-loss train step.
 
@@ -478,6 +500,10 @@ def make_train_step(
     with a ``reduce_fn``, ``axis_name`` must be given — without it, SPMD
     autodiff auto-sums grads of replicated params and an explicit reduce
     would double-count.
+
+    ``finite_axes``: mesh axes the *params* are sharded over (pipeline /
+    expert / tensor shards) — the overflow-skip decision is AND-reduced
+    across them (see :meth:`Amp.apply_gradients`).
     """
     if axis_name is None and reduce_fn is not None:
         axis_name = getattr(reduce_fn, "__self__", None) and \
@@ -499,7 +525,8 @@ def make_train_step(
 
         grads, (loss, aux) = jax.grad(scaled_loss, has_aux=True)(params_c)
         new_state, info = amp.apply_gradients(state, grads,
-                                              reduce_fn=reduce_fn)
+                                              reduce_fn=reduce_fn,
+                                              finite_axes=finite_axes)
         metrics = {"loss": loss, **info}
         if has_aux:
             metrics["aux"] = aux

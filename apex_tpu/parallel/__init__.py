@@ -7,6 +7,11 @@ Reference surface (``apex/parallel/__init__.py``): ``DistributedDataParallel``,
 
 from apex_tpu.optimizers.larc import LARC, larc
 from apex_tpu.parallel import mesh, multiproc
+from apex_tpu.parallel.moe import moe_apply, top1_routing
+from apex_tpu.parallel.pipeline import (
+    pipeline_apply,
+    stack_stage_params,
+)
 from apex_tpu.parallel.distributed import (
     DistributedDataParallel,
     ReduceConfig,
@@ -48,6 +53,8 @@ __all__ = [
     "DistributedDataParallel", "Reducer", "ReduceConfig", "ReduceOp",
     "all_reduce", "all_gather", "broadcast", "reduce_gradients",
     "pvary_params",
+    "pipeline_apply", "stack_stage_params",
+    "moe_apply", "top1_routing",
     "SyncBatchNorm", "BatchNorm", "convert_syncbn_model",
     "create_syncbn_process_group",
     "welford_mean_var", "welford_parallel", "batchnorm_forward",

@@ -119,7 +119,14 @@ def spawn(argslist: Sequence[str], world_size: Optional[int] = None,
                 for p in workers:
                     if p.poll() is None:
                         p.terminate()
-                return [p.wait() for p in workers]
+                results = []
+                for p in workers:  # timed: a SIGTERM-ignoring worker must
+                    try:           # not wedge the fail-fast path
+                        results.append(p.wait(timeout=5))
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                        results.append(p.wait())
+                return results
             time.sleep(0.2)
     finally:
         for p in workers:
