@@ -119,6 +119,22 @@ class FP16Optimizer:
         return (FP16OptimizerState(master, opt_state, new_sstate),
                 {"overflow": overflow, "loss_scale": new_sstate.loss_scale})
 
+    def step_with_closure(self, state: FP16OptimizerState,
+                          loss_fn: Callable, *args,
+                          clip_norm: Optional[float] = None
+                          ) -> Tuple[FP16OptimizerState, jax.Array, dict]:
+        """Closure-driven step (reference ``step(closure)``,
+        ``fp16_optimizer.py:361-460``): evaluate the scaled backward and
+        the conditional update in one call, returning
+        ``(new_state, loss, info)``.  optax transformations evaluate
+        gradients exactly once per step, so the closure runs once — the
+        reference re-invokes it only for multi-evaluation optimizers
+        (LBFGS-style), which have no optax counterpart here.
+        """
+        loss, grads = self.backward(state, loss_fn, *args)
+        new_state, info = self.step(state, grads, clip_norm=clip_norm)
+        return new_state, loss, info
+
     # -- checkpointing (``fp16_optimizer.py:298-359``) -------------------
 
     def state_dict(self, state: FP16OptimizerState) -> dict:

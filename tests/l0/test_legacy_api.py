@@ -96,3 +96,31 @@ def test_fp16_model_wrapper():
                       x.astype(jnp.bfloat16))
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(ref, np.float32))
+
+
+def test_fp16_optimizer_step_with_closure():
+    """Closure-driven step (reference fp16_optimizer.py:361-460): one call
+    runs the scaled backward and the conditional update; result equals the
+    explicit backward + step composition."""
+    import optax
+    from apex_tpu.fp16_utils.fp16_optimizer import FP16Optimizer
+
+    params = {"w": jnp.arange(4, dtype=jnp.float32) / 4}
+    opt = FP16Optimizer(optax.sgd(0.1), dynamic_loss_scale=True)
+    state = opt.init(params)
+    x = jnp.ones((4,))
+
+    def loss_fn(p, x):
+        return jnp.sum((p["w"].astype(jnp.float32) * x - 1.0) ** 2)
+
+    s1, loss1, info1 = opt.step_with_closure(state, loss_fn, x)
+    loss2, grads = opt.backward(state, loss_fn, x)
+    s2, info2 = opt.step(state, grads)
+    assert float(loss1) == float(loss2)
+    for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(s2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not bool(info1["overflow"])
+    # and it jits
+    jitted = jax.jit(lambda s, x: opt.step_with_closure(s, loss_fn, x))
+    s3, l3, _ = jitted(state, x)
+    assert float(l3) == float(loss1)
