@@ -37,6 +37,19 @@ class BertConfig:
     max_position_embeddings: int = 512
     type_vocab_size: int = 2
     layer_norm_eps: float = 1e-12
+    #: Stack the encoder as one ``nn.scan`` over a single compiled layer
+    #: body — layer params carry a leading ``num_layers`` axis (shardable
+    #: over an fsdp/pipeline mesh axis), and ``remat`` composes per layer.
+    #: Measured on one chip: step time identical to the unrolled loop
+    #: (XLA dedups the 24 copies), compile slightly slower at 24 layers,
+    #: so the named ``layer_{i}`` loop stays the default; turn this on for
+    #: remat, per-layer sharding, or very deep stacks.
+    scan_layers: bool = False
+    #: Rematerialize each layer's activations in the backward pass
+    #: (``jax.checkpoint`` through ``nn.remat``) — trades recompute FLOPs
+    #: for HBM, the lever for long sequences / big batches.  Effective on
+    #: both the scanned and the unrolled encoder.
+    remat: bool = False
 
 
 def bert_large() -> BertConfig:
@@ -111,6 +124,16 @@ class TransformerLayer(nn.Module):
                               name="ffn_ln")(x + h)
 
 
+class _ScanBody(nn.Module):
+    """Carry-shaped wrapper over :class:`TransformerLayer` for ``nn.scan``."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        return TransformerLayer(self.cfg, name="layer")(x, mask), None
+
+
 class BertModel(nn.Module):
     cfg: BertConfig
 
@@ -127,8 +150,27 @@ class BertModel(nn.Module):
                        name="seg_emb")(token_type_ids)
         x = FusedLayerNorm(c.hidden_size, eps=c.layer_norm_eps,
                            name="emb_ln")(tok + pos + seg)
-        for i in range(c.num_layers):
-            x = TransformerLayer(c, name=f"layer_{i}")(x, attention_mask)
+        if c.scan_layers:
+            # One compiled layer body scanned num_layers times; params get
+            # a leading layer axis (shard it over a pipeline/fsdp mesh axis
+            # if desired).  remat composes inside the scan: each layer's
+            # activations recompute in backward instead of living in HBM.
+            body = _ScanBody
+            if c.remat:
+                body = nn.remat(body, prevent_cse=False)
+            x, _ = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast,),
+                length=c.num_layers,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"},
+            )(c, name="layers")(x, attention_mask)
+        else:
+            layer_cls = (nn.remat(TransformerLayer, prevent_cse=False)
+                         if c.remat else TransformerLayer)
+            for i in range(c.num_layers):
+                x = layer_cls(c, name=f"layer_{i}")(x, attention_mask)
         return x
 
 
