@@ -13,9 +13,9 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu import amp
-from apex_tpu.models.bert import BertModel
 from apex_tpu.models import (
     BertForPreTraining,
+    BertModel,
     Discriminator,
     Generator,
     ResNet18,
