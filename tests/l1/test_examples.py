@@ -1,0 +1,47 @@
+"""Example entry-point smoke tests.
+
+The reference's examples were exercised only by the L1 shell harness on a
+GPU rig (``tests/L1/common/run_test.sh``); here every example runs headless
+at miniature scale in a subprocess (fresh JAX, CPU platform) so the
+user-facing entry points cannot bitrot.  Runtime knobs are the examples'
+own CLI flags — the same argparse surface the reference's harness drove.
+"""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[2]
+
+CASES = {
+    "mnist_amp.py": ["--steps", "2", "--batch-size", "16"],
+    "imagenet_main_amp.py": ["--steps", "2", "--batch-size", "2",
+                             "--image-size", "32", "--arch", "resnet18"],
+    "bert_pretraining.py": ["--steps", "2", "--batch-size", "2",
+                            "--seq-len", "32", "--size", "tiny"],
+    "dcgan_main_amp.py": ["--steps", "2", "--batch-size", "4"],
+    "simple_ddp.py": [],
+    "long_context_attention.py": ["--seq-len", "512", "--heads", "2",
+                                  "--head-dim", "32"],
+    "pipeline_moe.py": ["--mode", "ep", "--steps", "2"],
+}
+
+
+@pytest.mark.parametrize("script", sorted(CASES))
+def test_example_runs(script):
+    env = dict(os.environ,
+               PYTHONPATH=f"{REPO}:" + os.environ.get("PYTHONPATH", ""))
+    # conftest.py mutates XLA_FLAGS at import (virtual 8-device CPU mesh);
+    # strip it so each example's own device-count/platform settings win
+    flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                     if "xla_force_host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = flags
+    out = subprocess.run(
+        [sys.executable, str(REPO / "examples" / script)] + CASES[script],
+        capture_output=True, text=True, timeout=900, env=env,
+        cwd=str(REPO))
+    assert out.returncode == 0, (script, out.stdout[-2000:],
+                                 out.stderr[-2000:])
