@@ -424,6 +424,14 @@ def _jnp_attention(q, k, v, *, causal, kv_mask, scale, return_lse=False):
     return out, jnp.moveaxis(lse, 1, 2)
 
 
+def _default_block(l: int) -> int:
+    """Default q/k block edge by sequence length: 512, growing to 1024 at
+    L >= 4096 where fewer, larger grid steps measure ~20% faster on-chip
+    (per-step overhead amortizes; 2048 exceeds VMEM with the fp32 score
+    block)."""
+    return 1024 if l >= 4096 else 512
+
+
 def _varying(x) -> bool:
     try:
         return bool(jax.typeof(x).vma)
@@ -432,13 +440,16 @@ def _varying(x) -> bool:
 
 
 def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
-                    block_q=512, block_k=512, return_lse=False):
+                    block_q=None, block_k=None, return_lse=False):
     """Blockwise exact attention, ``(B, L, H, D)`` convention.
 
     Equivalent to the jnp reference path in :mod:`apex_tpu.attention`
     (scores never materialized; fp32 softmax; masked rows emit zeros).
     ``kv_mask``: optional ``(B, Lk)`` bool key mask (True = attend).
-    ``block_q``/``block_k`` are clamped to the (padded) sequence length.
+    ``block_q``/``block_k`` default by sequence length — 512, growing to
+    1024 at L >= 4096 where fewer, larger grid steps measure ~20% faster
+    on-chip (per-step overhead amortizes; 2048 blocks exceed VMEM with
+    the fp32 score block) — and are clamped to the (padded) length.
     Cross-attention (``Lq != Lk``) routes to an equivalent jnp path — the
     blockwise kernel packs q and k/v with one shared sequence length.
 
@@ -462,6 +473,10 @@ def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
         # merge algebra.
         return _jnp_attention(q, k, v, causal=causal, kv_mask=kv_mask,
                               scale=float(scale), return_lse=return_lse)
+    if block_q is None:
+        block_q = _default_block(l)
+    if block_k is None:
+        block_k = _default_block(l)
     block_q = min(block_q, _ceil_to(l, 128))
     block_k = min(block_k, _ceil_to(l, 128))
     if kv_mask is not None:

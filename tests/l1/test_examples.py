@@ -2,9 +2,11 @@
 
 The reference's examples were exercised only by the L1 shell harness on a
 GPU rig (``tests/L1/common/run_test.sh``); here every example runs headless
-at miniature scale in a subprocess (fresh JAX, CPU platform) so the
-user-facing entry points cannot bitrot.  Runtime knobs are the examples'
-own CLI flags — the same argparse surface the reference's harness drove.
+at miniature scale in a fresh subprocess (default platform — the real chip
+when present; the distributed ones pinned to a multi-device virtual CPU
+mesh so their collectives actually run) so the user-facing entry points
+cannot bitrot.  Runtime knobs are the examples' own CLI flags — the same
+argparse surface the reference's harness drove.
 """
 
 import os
@@ -23,9 +25,12 @@ CASES = {
     "bert_pretraining.py": ["--steps", "2", "--batch-size", "2",
                             "--seq-len", "32", "--size", "tiny"],
     "dcgan_main_amp.py": ["--steps", "2", "--batch-size", "4"],
-    "simple_ddp.py": [],
+    # distributed examples must actually be multi-device: force the
+    # virtual CPU mesh so the collectives (DDP allreduce, ring rotation)
+    # run for real
+    "simple_ddp.py": ["--force-cpu", "--world-size", "8"],
     "long_context_attention.py": ["--seq-len", "512", "--heads", "2",
-                                  "--head-dim", "32"],
+                                  "--head-dim", "32", "--force-cpu"],
     "pipeline_moe.py": ["--mode", "ep", "--steps", "2"],
 }
 
