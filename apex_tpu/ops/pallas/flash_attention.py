@@ -428,8 +428,12 @@ def _default_block(l: int) -> int:
     """Default q/k block edge by sequence length: 512, growing to 1024 at
     L >= 4096 where fewer, larger grid steps measure ~20% faster on-chip
     (per-step overhead amortizes; 2048 exceeds VMEM with the fp32 score
-    block)."""
-    return 1024 if l >= 4096 else 512
+    block) — but only when the larger block adds no padding: for L not
+    near a multiple of 1024 the padded sequence would grow, and the
+    quadratic extra attention work erases the per-step win."""
+    if l >= 4096 and _ceil_to(l, 1024) == _ceil_to(l, 512):
+        return 1024
+    return 512
 
 
 def _varying(x) -> bool:

@@ -75,4 +75,14 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception:
+        # One retry: the tunneled device occasionally drops a first
+        # attempt (observed transient trace/execute failure that succeeds
+        # immediately on rerun); the driver records this script's single
+        # JSON line, so don't let a hiccup cost the round's benchmark.
+        import traceback
+        traceback.print_exc()
+        time.sleep(15)
+        main()

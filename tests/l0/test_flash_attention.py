@@ -132,7 +132,15 @@ def test_default_blocks_scale_with_length():
     overhead amortization measured on chip); the selection logic is
     checked here, the numerics hardware-side below."""
     from apex_tpu.ops.pallas.flash_attention import _default_block
-    for l, expect in ((512, 512), (4095, 512), (4096, 1024), (16384, 1024)):
+    cases = (
+        (512, 512), (4095, 512), (4096, 1024), (16384, 1024),
+        # 1024 blocks would pad 4608 -> 5120 (~23% extra quadratic work)
+        # while 512 pads nothing: stay at 512.
+        (4608, 512),
+        # 4609 pads to 5120 under either block size: take the big block.
+        (4609, 1024),
+    )
+    for l, expect in cases:
         assert _default_block(l) == expect, l
 
 
