@@ -5,6 +5,9 @@
    :mod:`apex_tpu.models.resnet`
 4. BERT-large (FusedLAMB + FusedLayerNorm) — :mod:`apex_tpu.models.bert`
 5. DCGAN (two-loss-scaler GAN) — :mod:`apex_tpu.models.dcgan`
+
+Plus, beyond the reference: a GPT-style causal LM for the long-context /
+sequence-parallel training path — :mod:`apex_tpu.models.gpt`.
 """
 
 from apex_tpu.models.bert import (
@@ -17,6 +20,13 @@ from apex_tpu.models.bert import (
     pretraining_loss,
 )
 from apex_tpu.models.dcgan import Discriminator, Generator, gan_losses
+from apex_tpu.models.gpt import (
+    GPTConfig,
+    GPTModel,
+    gpt_small,
+    gpt_tiny,
+    lm_loss,
+)
 from apex_tpu.models.mlp import MLP, AmpDense, cross_entropy_loss
 from apex_tpu.models.resnet import (
     ARCHS,
@@ -37,4 +47,5 @@ __all__ = [
     "BertConfig", "BertModel", "BertForPreTraining",
     "bert_large", "bert_base", "bert_tiny", "pretraining_loss",
     "Generator", "Discriminator", "gan_losses",
+    "GPTConfig", "GPTModel", "gpt_small", "gpt_tiny", "lm_loss",
 ]

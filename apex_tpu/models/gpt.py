@@ -1,0 +1,174 @@
+"""GPT-style causal language model — the long-context training workload.
+
+Beyond the reference (2019-era apex has no LM and no long-context story,
+SURVEY.md section 5.7); this model exists so the framework's long-context
+machinery trains a *real* architecture end-to-end:
+
+- causal Pallas flash attention (``apex_tpu.ops.pallas.flash_attention``)
+  with rotary position embeddings — no (L, L) tensor in HBM, no learned
+  position table capping the context;
+- ``seq_axis_name`` switches attention to
+  :func:`~apex_tpu.attention.ring_attention` so the sequence dimension
+  shards over a mesh axis (context parallelism) while everything else is
+  untouched;
+- ``scan_layers`` / ``remat`` as in :class:`~apex_tpu.models.bert.BertModel`
+  (one compiled layer body; recompute-for-HBM);
+- FusedLayerNorm everywhere, matmuls at amp compute precision.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.layers import Dense
+from apex_tpu.normalization import FusedLayerNorm
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    layer_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    #: shard the sequence over this mesh axis (ring attention); None = local
+    seq_axis_name: Optional[str] = None
+    scan_layers: bool = False
+    remat: bool = False
+
+
+def gpt_small() -> GPTConfig:
+    return GPTConfig()
+
+
+def gpt_tiny() -> GPTConfig:
+    """Test-scale config."""
+    return GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                     num_heads=4, intermediate_size=128)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding on ``(B, L, H, D)`` with explicit positions —
+    positions are global indices, so a sequence-sharded rank rotates its
+    local shard correctly (ring attention needs only the local q/k)."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = jnp.exp(-jnp.log(theta)
+                    * jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, :, None].astype(jnp.float32) * freqs  # (B, L, half)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+class CausalSelfAttention(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        c = self.cfg
+        head_dim = c.hidden_size // c.num_heads
+        qkv = Dense(3 * c.hidden_size, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(t.shape[0], t.shape[1], c.num_heads, head_dim)
+
+        q = rope(heads(q), positions, c.rope_theta)
+        k = rope(heads(k), positions, c.rope_theta)
+        v = heads(v)
+        scale = 1.0 / float(head_dim) ** 0.5
+        from apex_tpu.attention import attention
+        # local: the Pallas flash kernel (jnp path off-TPU); with
+        # seq_axis_name: ring attention over the mesh axis
+        out = attention(q, k, v, axis_name=c.seq_axis_name, causal=True,
+                        scale=scale)
+        out = out.reshape(x.shape[0], x.shape[1], c.hidden_size)
+        return Dense(c.hidden_size, name="out")(out)
+
+
+class GPTBlock(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        c = self.cfg
+        h = FusedLayerNorm(c.hidden_size, eps=c.layer_norm_eps,
+                           name="ln1")(x)
+        x = x + CausalSelfAttention(c, name="attention")(h, positions)
+        h = FusedLayerNorm(c.hidden_size, eps=c.layer_norm_eps,
+                           name="ln2")(x)
+        h = Dense(c.intermediate_size, name="ffn_in")(h)
+        h = nn.gelu(h)
+        return x + Dense(c.hidden_size, name="ffn_out")(h)
+
+
+class _ScanBody(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        return GPTBlock(self.cfg, name="block")(x, positions), None
+
+
+class GPTModel(nn.Module):
+    """Decoder-only transformer; ``__call__(input_ids, positions=None)``
+    returns logits ``(B, L, vocab)``.
+
+    ``positions`` are *global* token indices ``(B, L)``; when the sequence
+    is sharded over ``seq_axis_name``, pass each rank its own slice (see
+    :func:`lm_loss` and the sp dryrun slice) — defaults to ``0..L-1``.
+    """
+
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        c = self.cfg
+        B, L = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(L)[None, :], (B, L))
+        x = nn.Embed(c.vocab_size, c.hidden_size, name="tok_emb")(input_ids)
+        if c.scan_layers:
+            body = _ScanBody
+            if c.remat:
+                body = nn.remat(body, prevent_cse=False)
+            x, _ = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast,),
+                length=c.num_layers,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"},
+            )(c, name="layers")(x, positions)
+        else:
+            block_cls = (nn.remat(GPTBlock, prevent_cse=False)
+                         if c.remat else GPTBlock)
+            for i in range(c.num_layers):
+                x = block_cls(c, name=f"block_{i}")(x, positions)
+        x = FusedLayerNorm(c.hidden_size, eps=c.layer_norm_eps,
+                           name="ln_f")(x)
+        return Dense(c.vocab_size, use_bias=False, name="lm_head")(x)
+
+
+def lm_loss(logits: jax.Array, targets: jax.Array,
+            mask: Optional[jax.Array] = None) -> jax.Array:
+    """Mean next-token cross entropy in fp32.  ``targets`` are the
+    *shifted* labels (callers shift; under sequence sharding each rank
+    shifts within its shard and masks the seam or supplies the neighbor's
+    first token)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        return -jnp.mean(picked)
+    m = mask.astype(jnp.float32)
+    return -jnp.sum(picked * m) / jnp.maximum(jnp.sum(m), 1.0)
