@@ -39,6 +39,11 @@ from apex_tpu import optimizers
 from apex_tpu import parallel
 from apex_tpu import rnn
 
+#: The reference spells the RNN package ``apex.RNN`` (not auto-imported
+#: there; ``apex/__init__.py:1-13``) — keep the capitalized alias so
+#: migrating code finds it.
+RNN = rnn
+
 __version__ = "0.1.0"
 
 __all__ = [
@@ -50,5 +55,6 @@ __all__ = [
     "optimizers",
     "parallel",
     "rnn",
+    "RNN",
     "__version__",
 ]

@@ -54,27 +54,42 @@ def gpt_tiny() -> GPTConfig:
                      num_heads=4, intermediate_size=128)
 
 
-def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary embedding on ``(B, L, H, D)`` with explicit positions —
-    positions are global indices, so a sequence-sharded rank rotates its
-    local shard correctly (ring attention needs only the local q/k)."""
-    d = x.shape[-1]
-    half = d // 2
+def rope_tables(positions: jax.Array, head_dim: int,
+                theta: float) -> tuple:
+    """(cos, sin) rotation tables ``(B, L, 1, head_dim//2)`` from *global*
+    position indices — computed once per step and shared by q and k across
+    every layer (they depend only on positions), so the transcendentals
+    stay out of the scanned/remat layer body."""
+    half = head_dim // 2
     freqs = jnp.exp(-jnp.log(theta)
                     * jnp.arange(0, half, dtype=jnp.float32) / half)
     angles = positions[:, :, None].astype(jnp.float32) * freqs  # (B, L, half)
-    cos = jnp.cos(angles)[:, :, None, :]
-    sin = jnp.sin(angles)[:, :, None, :]
-    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return jnp.cos(angles)[:, :, None, :], jnp.sin(angles)[:, :, None, :]
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate ``(B, L, H, D)`` by precomputed tables."""
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
     return out.astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """One-shot rotary embedding (tables + apply); positions are global
+    indices, so a sequence-sharded rank rotates its local shard
+    correctly."""
+    cos, sin = rope_tables(positions, x.shape[-1], theta)
+    return apply_rope(x, cos, sin)
 
 
 class CausalSelfAttention(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, rope_cs):
         c = self.cfg
         head_dim = c.hidden_size // c.num_heads
         qkv = Dense(3 * c.hidden_size, name="qkv")(x)
@@ -83,8 +98,9 @@ class CausalSelfAttention(nn.Module):
         def heads(t):
             return t.reshape(t.shape[0], t.shape[1], c.num_heads, head_dim)
 
-        q = rope(heads(q), positions, c.rope_theta)
-        k = rope(heads(k), positions, c.rope_theta)
+        cos, sin = rope_cs
+        q = apply_rope(heads(q), cos, sin)
+        k = apply_rope(heads(k), cos, sin)
         v = heads(v)
         scale = 1.0 / float(head_dim) ** 0.5
         from apex_tpu.attention import attention
@@ -100,11 +116,11 @@ class GPTBlock(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, rope_cs):
         c = self.cfg
         h = FusedLayerNorm(c.hidden_size, eps=c.layer_norm_eps,
                            name="ln1")(x)
-        x = x + CausalSelfAttention(c, name="attention")(h, positions)
+        x = x + CausalSelfAttention(c, name="attention")(h, rope_cs)
         h = FusedLayerNorm(c.hidden_size, eps=c.layer_norm_eps,
                            name="ln2")(x)
         h = Dense(c.intermediate_size, name="ffn_in")(h)
@@ -116,8 +132,8 @@ class _ScanBody(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x, positions):
-        return GPTBlock(self.cfg, name="block")(x, positions), None
+    def __call__(self, x, rope_cs):
+        return GPTBlock(self.cfg, name="block")(x, rope_cs), None
 
 
 class GPTModel(nn.Module):
@@ -138,6 +154,10 @@ class GPTModel(nn.Module):
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(L)[None, :], (B, L))
         x = nn.Embed(c.vocab_size, c.hidden_size, name="tok_emb")(input_ids)
+        # rope tables depend only on positions: compute once, share across
+        # q/k and every layer (kept out of the scanned/remat body)
+        rope_cs = rope_tables(positions, c.hidden_size // c.num_heads,
+                              c.rope_theta)
         if c.scan_layers:
             body = _ScanBody
             if c.remat:
@@ -149,26 +169,41 @@ class GPTModel(nn.Module):
                 in_axes=(nn.broadcast,),
                 length=c.num_layers,
                 metadata_params={nn.meta.PARTITION_NAME: "layers"},
-            )(c, name="layers")(x, positions)
+            )(c, name="layers")(x, rope_cs)
         else:
             block_cls = (nn.remat(GPTBlock, prevent_cse=False)
                          if c.remat else GPTBlock)
             for i in range(c.num_layers):
-                x = block_cls(c, name=f"block_{i}")(x, positions)
+                x = block_cls(c, name=f"block_{i}")(x, rope_cs)
         x = FusedLayerNorm(c.hidden_size, eps=c.layer_norm_eps,
                            name="ln_f")(x)
         return Dense(c.vocab_size, use_bias=False, name="lm_head")(x)
 
 
 def lm_loss(logits: jax.Array, targets: jax.Array,
-            mask: Optional[jax.Array] = None) -> jax.Array:
+            mask: Optional[jax.Array] = None,
+            seq_axis_name: Optional[str] = None) -> jax.Array:
     """Mean next-token cross entropy in fp32.  ``targets`` are the
     *shifted* labels (callers shift; under sequence sharding each rank
     shifts within its shard and masks the seam or supplies the neighbor's
-    first token)."""
+    first token).
+
+    With ``seq_axis_name`` (sequence-sharded training) the normalizer is
+    the *global* token count (``psum`` of the mask over the axis), so each
+    shard returns ``local_sum / global_count``.  SPMD autodiff sums the
+    replicated params' grads across shards, which then reconstructs
+    exactly the gradient of the global mean — normalizing per shard
+    instead would silently scale gradients by the shard count.  Report
+    the global loss as ``lax.psum(loss, axis)`` (not pmean).
+    """
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     if mask is None:
-        return -jnp.mean(picked)
-    m = mask.astype(jnp.float32)
-    return -jnp.sum(picked * m) / jnp.maximum(jnp.sum(m), 1.0)
+        m = jnp.ones(picked.shape, jnp.float32)
+    else:
+        m = mask.astype(jnp.float32)
+    total = -jnp.sum(picked * m)
+    count = jnp.sum(m)
+    if seq_axis_name is not None:
+        count = jax.lax.psum(count, seq_axis_name)
+    return total / jnp.maximum(count, 1.0)

@@ -32,6 +32,8 @@ CASES = {
     "long_context_attention.py": ["--seq-len", "512", "--heads", "2",
                                   "--head-dim", "32", "--force-cpu"],
     "pipeline_moe.py": ["--mode", "ep", "--steps", "2"],
+    "gpt_lm.py": ["--steps", "2", "--seq-len", "64", "--batch-size", "2",
+                  "--seq-parallel", "--devices", "4", "--force-cpu"],
 }
 
 
