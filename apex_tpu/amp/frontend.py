@@ -483,6 +483,7 @@ def make_train_step(
     reduce_fn: Optional[Callable[[Any], Any]] = None,
     has_aux: bool = False,
     finite_axes: Optional[Sequence[str]] = None,
+    accum_steps: Optional[int] = None,
 ):
     """Build a jittable single-loss train step.
 
@@ -504,6 +505,22 @@ def make_train_step(
     ``finite_axes``: mesh axes the *params* are sharded over (pipeline /
     expert / tensor shards) — the overflow-skip decision is AND-reduced
     across them (see :meth:`Amp.apply_gradients`).
+
+    ``accum_steps``: gradient accumulation over N micro-batches — the
+    reference's stashed-grad iteration (``_process_optimizer.py:125-129``)
+    and the ``Reducer``'s every-N cadence, as one compiled ``lax.scan``:
+    every batch argument's leading dim splits into ``(N, batch/N)``,
+    scaled grads accumulate across micro-steps, and ONE
+    unscale/scaler-update/conditional-step runs at the end.  Grads
+    accumulate in fp32 (like the reference's fp32 master grads) and,
+    with the reported loss, are averaged over micro-steps, so the step
+    is numerically the large-batch mean-loss step (an inf in ANY
+    micro-batch skips it — the accumulated sum stays non-finite, the
+    reference's shared overflow buffer).  ``reduce_fn``/``axis_name``
+    reduction applies once to the accumulated grads, the
+    ``delay_allreduce=True`` economics.  Every batch argument must carry
+    the leading batch dim; with ``has_aux`` the aux comes back stacked
+    per micro-step (leading ``(N,)`` dim).
     """
     if axis_name is None and reduce_fn is not None:
         axis_name = getattr(reduce_fn, "__self__", None) and \
@@ -518,12 +535,54 @@ def make_train_step(
         if axis_name is not None:
             params_c = pvary_params(params_c, axis_name)
 
-        def scaled_loss(p):
-            out = amp.run(loss_fn, p, *batch)
+        def scaled_loss(p, micro):
+            out = amp.run(loss_fn, p, *micro)
             loss, aux = out if has_aux else (out, None)
             return amp.scale_loss(loss, state), (loss, aux)
 
-        grads, (loss, aux) = jax.grad(scaled_loss, has_aux=True)(params_c)
+        if accum_steps is None or accum_steps == 1:
+            grads, (loss, aux) = jax.grad(
+                lambda p: scaled_loss(p, batch), has_aux=True)(params_c)
+        else:
+            def split(t):
+                t = jnp.asarray(t)
+                if t.ndim == 0 or t.shape[0] % accum_steps:
+                    raise ValueError(
+                        f"accum_steps={accum_steps}: every batch argument "
+                        f"leaf must have a leading dim divisible by it; "
+                        f"got shape {t.shape} (broadcast non-batched "
+                        "extras inside loss_fn instead of passing them "
+                        "as batch args)")
+                return t.reshape((accum_steps, t.shape[0] // accum_steps)
+                                 + t.shape[1:])
+
+            micro_batches = jax.tree.map(split, batch)
+
+            def body(acc, micro):
+                g, (loss, aux) = jax.grad(
+                    lambda p: scaled_loss(p, micro),
+                    has_aux=True)(params_c)
+                # accumulate in fp32 regardless of compute dtype: summing
+                # in bf16 would absorb small micro-contributions (the
+                # reference accumulates into fp32 master grads)
+                acc = jax.tree.map(
+                    lambda a, gi: a + gi.astype(a.dtype), acc, g)
+                return acc, (loss, aux)
+
+            zero = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params_c)
+            grads, (losses, auxes) = jax.lax.scan(body, zero,
+                                                  micro_batches)
+            # mean-loss semantics: the accumulated step equals the
+            # large-batch mean-loss step (grads scaled by 1/N; an inf in
+            # any micro-batch survives the sum and skips the step)
+            grads = jax.tree.map(lambda g: g / accum_steps, grads)
+            loss = jnp.mean(losses)
+            # per-micro aux stacked with a leading (accum_steps,) dim —
+            # documented; reduce it yourself (e.g. take aux[-1] for
+            # carried stats)
+            aux = auxes if has_aux else None
+
         new_state, info = amp.apply_gradients(state, grads,
                                               reduce_fn=reduce_fn,
                                               finite_axes=finite_axes)

@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from apex_tpu import amp
 from apex_tpu.models.mlp import MLP, cross_entropy_loss
@@ -123,3 +124,53 @@ def test_scaler_level_stashed_check_is_arg0_only():
     g1 = _micro_grads(model, a, state, x, y, 1)
     _, f = a.scaler.unscale_with_stashed(g1, accum, sstate)
     assert bool(f)   # per-call flag sees only the new grads
+
+
+def test_make_train_step_accum_matches_big_batch():
+    """make_train_step(accum_steps=N): the compiled accumulation loop must
+    match the single large-batch mean-loss step (same params update, same
+    reported loss) — the Reducer's every-N cadence as one jit."""
+    model, params, a, x, y = _setup()
+    def loss_fn(p, xb, yb):
+        return cross_entropy_loss(model.apply({"params": p}, xb), yb)
+
+    big = jax.jit(amp.make_train_step(a, loss_fn))
+    accum = jax.jit(amp.make_train_step(a, loss_fn,
+                                        accum_steps=N_MICRO))
+    s_big, m_big = big(a.init(params), x, y)
+    s_acc, m_acc = accum(a.init(params), x, y)
+    np.testing.assert_allclose(float(m_acc["loss"]), float(m_big["loss"]),
+                               rtol=1e-5)
+    for la, lb in zip(jax.tree.leaves(s_acc.master_params),
+                      jax.tree.leaves(s_big.master_params)):
+        # mean-of-micro-means vs full-batch mean reassociates the
+        # reduction; bf16 compute wobbles at ~1e-5 absolute
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=1e-3, atol=5e-5)
+
+
+def test_make_train_step_accum_overflow_in_any_micro_skips():
+    """An inf produced by any micro-batch must skip the whole accumulated
+    step (the shared overflow buffer across unscales)."""
+    model, params, a, x, y = _setup()
+    x_bad = x.at[2 * BATCH + 1, 0].set(jnp.inf)  # poisons micro-batch 2
+
+    def loss_fn(p, xb, yb):
+        return cross_entropy_loss(model.apply({"params": p}, xb), yb)
+
+    accum = jax.jit(amp.make_train_step(a, loss_fn, accum_steps=N_MICRO))
+    state0 = a.init(params)
+    state1, m = accum(state0, x_bad, y)
+    assert bool(m["overflow"])
+    for la, lb in zip(jax.tree.leaves(state1.master_params),
+                      jax.tree.leaves(state0.master_params)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_make_train_step_accum_rejects_indivisible_batch():
+    model, params, a, x, y = _setup()
+    def loss_fn(p, xb, yb):
+        return cross_entropy_loss(model.apply({"params": p}, xb), yb)
+    accum = amp.make_train_step(a, loss_fn, accum_steps=3)
+    with pytest.raises(ValueError, match="divisible"):
+        jax.eval_shape(accum, a.init(params), x, y)
