@@ -56,10 +56,31 @@ def pack(tensors: Sequence[jax.Array], chunk_size: int) -> Tuple[jax.Array, Pack
 
 
 def unpack(flat: jax.Array, meta: PackMeta) -> List[jax.Array]:
-    """Slice a flat buffer back into the original shapes."""
+    """Slice a flat buffer back into the original shapes.
+
+    Many direct slices off one large 1-D buffer trip a TPU AOT layout
+    pathology (the buffer materializes as an (N/2, 2) pairs view whose
+    (8,128) tiling pads the minor dim 64x — see ``unpack_aligned``), so
+    when the padded buffer is lane-divisible each leaf is carved by
+    slicing a 128-lane ROW window of the 2-D view first (bounded piece),
+    then trimming the unaligned head/tail on the small piece only."""
+    lanes = 128
     out = []
+    if meta.padded % lanes == 0 and flat.shape[0] == meta.padded:
+        rows = flat.reshape(-1, lanes)
+        for shape, size, offset in zip(meta.shapes, meta.sizes,
+                                       meta.offsets):
+            r0 = offset // lanes
+            r1 = -(-(offset + size) // lanes)
+            piece = jax.lax.dynamic_slice_in_dim(rows, r0, r1 - r0, 0)
+            head = offset - r0 * lanes
+            piece = jax.lax.dynamic_slice_in_dim(
+                piece.reshape(-1), head, size)
+            out.append(piece.reshape(shape))
+        return out
     for shape, size, offset in zip(meta.shapes, meta.sizes, meta.offsets):
-        out.append(jax.lax.dynamic_slice_in_dim(flat, offset, size).reshape(shape))
+        out.append(jax.lax.dynamic_slice_in_dim(flat, offset,
+                                                size).reshape(shape))
     return out
 
 
@@ -120,7 +141,12 @@ def pack_aligned(tensors: Sequence[jax.Array],
         flat = jnp.ravel(t)
         if padded != size:
             flat = jnp.pad(flat, (0, padded - size))
-        parts.append(flat)
+        # Concatenate CHUNK-SHAPED 2-D pieces, not 1-D ravels: at ~100M+
+        # elements the TPU AOT compiler lowers a many-way 1-D concat
+        # through an (N/2, 2) intermediate whose (8,128)-tiled layout pads
+        # the minor dim 2 -> 128 (observed 64x HBM blowup = 34 GB on a
+        # bert-base param pack).  Chunk-wide rows tile cleanly.
+        parts.append(flat.reshape(n_chunks, chunk_size))
         shapes.append(tuple(t.shape))
         sizes.append(size)
         offsets.append(off)
@@ -129,7 +155,7 @@ def pack_aligned(tensors: Sequence[jax.Array],
     meta = AlignedMeta(shapes=tuple(shapes), sizes=tuple(sizes),
                        offsets=tuple(offsets), chunk_size=chunk_size,
                        padded=off, chunk_ids=tuple(chunk_ids), dtype=dtype)
-    return jnp.concatenate(parts), meta
+    return jnp.concatenate(parts, axis=0).reshape(-1), meta
 
 
 def pack_into(tensors: Sequence[jax.Array], meta: AlignedMeta) -> jax.Array:
@@ -145,13 +171,33 @@ def pack_into(tensors: Sequence[jax.Array], meta: AlignedMeta) -> jax.Array:
         padded = next_off - off
         if padded != size:
             flat = jnp.pad(flat, (0, padded - size))
-        parts.append(flat)
-    return jnp.concatenate(parts)
+        # chunk-shaped 2-D pieces for the same layout reason as
+        # pack_aligned (1-D many-way concat blows up on the TPU AOT
+        # compiler at scale)
+        parts.append(flat.reshape(-1, meta.chunk_size))
+    return jnp.concatenate(parts, axis=0).reshape(-1)
 
 
-# Aligned buffers unpack with the same slice-and-reshape as contiguous ones
-# (AlignedMeta shares the shapes/sizes/offsets prefix with PackMeta).
-unpack_aligned = unpack
+def unpack_aligned(flat: jax.Array, meta: AlignedMeta) -> List[jax.Array]:
+    """Slice an aligned flat buffer back into the original shapes.
+
+    Slices CHUNK ROWS off the 2-D ``(n_chunks, chunk_size)`` view instead
+    of offsets off the 1-D buffer: every tensor starts on a chunk boundary
+    by construction, and at ~100M+ elements the TPU AOT compiler
+    materializes a many-slice-consumed 1-D buffer through an (N/2, 2)
+    intermediate whose (8,128)-tiled layout pads the minor dim 64x
+    (the same pathology the 2-D concat in :func:`pack_aligned` avoids)."""
+    rows = flat.reshape(-1, meta.chunk_size)
+    out = []
+    for shape, size, off in zip(meta.shapes, meta.sizes, meta.offsets):
+        n_chunks = -(-size // meta.chunk_size)
+        piece = jax.lax.dynamic_slice_in_dim(
+            rows, off // meta.chunk_size, n_chunks, 0)
+        flat_piece = piece.reshape(-1)
+        if n_chunks * meta.chunk_size != size:
+            flat_piece = jax.lax.slice_in_dim(flat_piece, 0, size)
+        out.append(flat_piece.reshape(shape))
+    return out
 
 
 def group_by_dtype(tensors: Sequence[jax.Array]):

@@ -103,3 +103,36 @@ def test_pallas_bias_correction_off(monkeypatch):
     for r, o in zip(jax.tree.leaves(ref), jax.tree.leaves(got)):
         np.testing.assert_allclose(np.asarray(r), np.asarray(o),
                                    rtol=2e-5, atol=1e-7)
+
+
+@pytest.mark.skipif(jax.default_backend() == "cpu",
+                    reason="reproduces a TPU AOT layout pathology; "
+                    "run with APEX_TPU_TEST_PLATFORM")
+def test_packed_lamb_at_bert_base_scale():
+    """Regression: a ~133M-param, 159-leaf tree (bert-base shape census)
+    must pack/update/unpack without the (N/2, 2) pairs intermediate whose
+    (8,128)-tiled layout allocates 64x the buffer (34 GB observed) — the
+    reason pack_aligned concatenates chunk-shaped rows and unpack_aligned
+    slices rows, not 1-D offsets."""
+    from apex_tpu.models.bert import BertForPreTraining, bert_base
+    from apex_tpu.optimizers.fused_lamb import _pallas_lamb_update
+
+    model = BertForPreTraining(bert_base())
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.ones((1, 8), jnp.int32)))["params"]
+    ps = [jnp.full(l.shape, 0.01, jnp.float32)
+          for l in jax.tree.leaves(shapes)]
+    gs = [jnp.full(p.shape, 1e-4, jnp.float32) for p in ps]
+    zs = [jnp.zeros(p.shape, jnp.float32) for p in ps]
+
+    @jax.jit
+    def upd(gs, ps, ms, vs):
+        deltas, nm, nv = _pallas_lamb_update(
+            gs, ps, ms, vs, lr=jnp.float32(1e-3), beta1=0.9, beta2=0.999,
+            eps=1e-6, weight_decay=0.01, clip=jnp.float32(1.0),
+            bc1=jnp.float32(1.0), bc2=jnp.float32(1.0))
+        return sum(jnp.sum(d.astype(jnp.float32)) for d in deltas)
+
+    out = float(upd(gs, ps, zs, zs))
+    assert np.isfinite(out) and out != 0.0
