@@ -149,12 +149,13 @@ class Amp:
         is initialized fresh and every leaf whose tree path already
         existed (same shape/dtype) is grafted back from the old state.
 
-        Caveat vs the reference: optimizers with one *global* step counter
-        (FusedAdam/FusedLAMB here) keep that counter, so bias correction
-        treats the new subtree as mid-training (its zero moments warm up
-        over ~1/(1-beta) steps with slightly larger first updates).  The
-        reference's per-group step starts new groups at 0; use a
-        per-param-count optimizer if that exact behavior matters.
+        FusedAdam/FusedLAMB carry a per-leaf ``leaf_step`` pytree (the
+        reference's per-param ``state['step']``, ``fused_adam.py:119-125``),
+        so grafting preserves existing leaves' counts while new leaves
+        start at step 0 — bias correction treats the new subtree as
+        freshly initialized, exactly like the reference's
+        ``add_param_group``.  Only the global schedule counter
+        ``state.step`` is shared.
         """
         master = state.master_params
         if not isinstance(master, dict) or not isinstance(new_params, dict):

@@ -239,9 +239,11 @@ def _conv(x, kernel, bias=None, *, window_strides=None, padding="SAME",
     if window_strides is None:
         window_strides = (1,) * spatial
     if dimension_numbers is None:
-        chars = "DHW"[-spatial:] if spatial <= 3 else None
-        if chars is None:
-            raise ValueError("give dimension_numbers for >3 spatial dims")
+        if not 1 <= spatial <= 3:
+            raise ValueError(
+                f"conv input must have 1-3 spatial dims (got rank {x.ndim} "
+                f"= {spatial} spatial); give dimension_numbers explicitly")
+        chars = "DHW"[-spatial:]
         dimension_numbers = (f"N{chars}C", f"{chars}IO", f"N{chars}C")
     y = lax.conv_general_dilated(x, kernel, window_strides=window_strides,
                                  padding=padding,

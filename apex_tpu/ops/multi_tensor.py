@@ -147,20 +147,54 @@ def multi_tensor_l2norm(
     """Global L2 norm over a tensor list, optionally per-tensor norms too
     (``multi_tensor_l2norm_kernel.cu:117-180`` returns both).
 
-    Per-tensor norms are computed as per-leaf fp32 reductions (XLA emits a
-    tight reduction per tensor); the packed Pallas path accelerates only the
-    *global* norm where one pass over the flat buffer wins.
+    Per-tensor norms run fused too: chunk-ALIGNED packing (every chunk
+    belongs to exactly one tensor) + a per-chunk sum-of-squares kernel,
+    segment-reduced through the chunk→tensor table — the TPU shape of the
+    CUDA kernel's ``output_per_tensor`` path.  The global norm reuses the
+    same partials (``sqrt(sum(per))``), one pass over HBM either way.
     """
     ins = list(tensor_lists[0])
     if not ins:
         z = jnp.zeros((), jnp.float32)
         return z, (jnp.zeros((0,), jnp.float32) if per_tensor else None)
 
-    if use_pallas() and not per_tensor and ker.chunk_supported(chunk_size):
-        total = jnp.zeros((), jnp.float32)
-        for dtype, idxs in packing.group_by_dtype(ins).items():
-            flat, _ = packing.pack([ins[i] for i in idxs], chunk_size)
-            total = total + ker.packed_sumsq(flat, chunk_size)
-        return jnp.sqrt(total), None
+    if use_pallas() and ker.chunk_supported(chunk_size):
+        if per_tensor:
+            # SMEM table bound checked for ALL dtype groups up front (same
+            # chunk-count formula as pack_aligned) so no pallas work is
+            # done and then discarded when a later group overflows.
+            groups = packing.group_by_dtype(ins)
+            fits = all(
+                sum(-(-(int(np.prod(ins[i].shape)) if ins[i].shape else 1)
+                      // chunk_size) for i in idxs) <= ker.MAX_SUMSQ_CHUNKS
+                for idxs in groups.values())
+            if fits:
+                per_sq: List[Optional[jax.Array]] = [None] * len(ins)
+                for dtype, idxs in groups.items():
+                    flat, meta = packing.pack_aligned(
+                        [ins[i] for i in idxs], chunk_size)
+                    sums = per_tensor_sumsq_from_packed(flat, meta)
+                    for j, i in enumerate(idxs):
+                        per_sq[i] = sums[j]
+                per = jnp.stack(per_sq)
+                return jnp.sqrt(per.sum()), jnp.sqrt(per)
+        else:
+            total = jnp.zeros((), jnp.float32)
+            for dtype, idxs in packing.group_by_dtype(ins).items():
+                flat, _ = packing.pack([ins[i] for i in idxs], chunk_size)
+                total = total + ker.packed_sumsq(flat, chunk_size)
+            return jnp.sqrt(total), None
     per = jnp.stack([jnp.sum(jnp.square(t.astype(jnp.float32))) for t in ins])
     return jnp.sqrt(per.sum()), (jnp.sqrt(per) if per_tensor else None)
+
+
+def per_tensor_sumsq_from_packed(flat: jax.Array,
+                                 meta: packing.AlignedMeta) -> jax.Array:
+    """Per-tensor sums of squares of an already chunk-aligned flat buffer:
+    the fused per-chunk kernel + a segment add over ``meta.chunk_ids``.
+    Shared by :func:`multi_tensor_l2norm` and FusedLAMB's inter-stage
+    ‖p‖/‖update‖ norms (the per-tensor l2norm output feeding LAMB stage 2
+    in the reference)."""
+    chunk_sums = ker.packed_sumsq_per_chunk(flat, meta.chunk_size)
+    ids = jnp.asarray(np.array(meta.chunk_ids), jnp.int32)
+    return jnp.zeros((len(meta.shapes),), jnp.float32).at[ids].add(chunk_sums)

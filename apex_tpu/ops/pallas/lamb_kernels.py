@@ -47,18 +47,21 @@ LAMB_CHUNK_MAX = 64 * 1024
 
 
 
-def _stage1_kernel(scalars_ref, decay_ref, g_ref, p_ref, m_ref, v_ref,
-                   u_ref, out_m_ref, out_v_ref):
+def _stage1_kernel(scalars_ref, decay_ref, bc1_ref, bc2_ref, g_ref, p_ref,
+                   m_ref, v_ref, u_ref, out_m_ref, out_v_ref):
     beta1 = scalars_ref[0]
     beta2 = scalars_ref[1]
     eps = scalars_ref[2]
     inv_scale = scalars_ref[3]   # 1 / clip_factor (grads arrive descaled)
-    bc1 = scalars_ref[4]         # 1 - beta1^step (or 1.0)
-    bc2 = scalars_ref[5]
-    # Per-tensor weight decay resolved through the chunk->tensor table in
-    # SMEM, indexed by grid position — the role of TensorListMetadata's
-    # block_to_tensor map (multi_tensor_apply.cuh:17-24).
+    # Per-tensor weight decay AND bias correction (1 - beta^step, or 1.0)
+    # resolved through the chunk->tensor tables in SMEM, indexed by grid
+    # position — the role of TensorListMetadata's block_to_tensor map
+    # (multi_tensor_apply.cuh:17-24).  Bias correction is per tensor, not
+    # a launch-wide scalar, because each param leaf carries its own step
+    # count (reference fused_adam.py:119-125 state per param).
     decay = decay_ref[pl.program_id(0)]
+    bc1 = bc1_ref[pl.program_id(0)]
+    bc2 = bc2_ref[pl.program_id(0)]
 
     g = g_ref[...].astype(jnp.float32) * inv_scale
     p = p_ref[...].astype(jnp.float32)
@@ -78,8 +81,10 @@ def packed_lamb_stage1(g: jax.Array, p: jax.Array, m: jax.Array,
     """Stage 1 over chunk-aligned flat fp32 buffers.
 
     ``per_chunk_decay``: fp32 ``(n_chunks,)`` — weight decay per chunk (i.e.
-    per tensor, via ``AlignedMeta.chunk_ids``).  Returns
-    ``(update, new_m, new_v)`` flat fp32 buffers.
+    per tensor, via ``AlignedMeta.chunk_ids``).  ``bc1``/``bc2`` may be
+    scalars (all tensors at the same step) or ``(n_chunks,)`` arrays
+    (per-tensor step counts).  Returns ``(update, new_m, new_v)`` flat
+    fp32 buffers.
     """
     n = g.shape[0]
     n_chunks = n // chunk_size
@@ -89,9 +94,9 @@ def packed_lamb_stage1(g: jax.Array, p: jax.Array, m: jax.Array,
         jnp.asarray(beta2, jnp.float32),
         jnp.asarray(eps, jnp.float32),
         jnp.asarray(inv_scale, jnp.float32),
-        jnp.asarray(bc1, jnp.float32),
-        jnp.asarray(bc2, jnp.float32),
     ])
+    bc1 = jnp.broadcast_to(jnp.asarray(bc1, jnp.float32), (n_chunks,))
+    bc2 = jnp.broadcast_to(jnp.asarray(bc2, jnp.float32), (n_chunks,))
 
     def spec():
         return pl.BlockSpec(br, lambda i: (i, 0))
@@ -102,14 +107,16 @@ def packed_lamb_stage1(g: jax.Array, p: jax.Array, m: jax.Array,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             spec(), spec(), spec(), spec(),
         ],
         out_specs=[spec(), spec(), spec()],
         out_shape=[sds((n // _LANES, _LANES), jnp.float32, g, p, m, v)
                    for _ in range(3)],
         interpret=not on_tpu(),
-    )(scalars, per_chunk_decay.astype(jnp.float32), _view2d(g), _view2d(p),
-      _view2d(m), _view2d(v))
+    )(scalars, per_chunk_decay.astype(jnp.float32), bc1, bc2, _view2d(g),
+      _view2d(p), _view2d(m), _view2d(v))
     return u.reshape(-1), new_m.reshape(-1), new_v.reshape(-1)
 
 

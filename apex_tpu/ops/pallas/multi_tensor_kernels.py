@@ -155,6 +155,38 @@ def _sumsq_kernel(x_ref, acc_ref):
     acc_ref[0] += (x * x).sum()
 
 
+#: Bound on the per-chunk sumsq SMEM table (fp32 per chunk, 128 KiB against
+#: the ~1 MiB SMEM budget); beyond it drivers fall back to per-leaf jnp
+#: reductions rather than fail Mosaic compilation.
+MAX_SUMSQ_CHUNKS = 32768
+
+
+def _sumsq_per_chunk_kernel(x_ref, acc_ref):
+    x = x_ref[...].astype(jnp.float32)
+    acc_ref[pl.program_id(0)] = (x * x).sum()
+
+
+@functools.partial(jax.jit, static_argnames=("chunk_size",))
+def packed_sumsq_per_chunk(flat: jax.Array, chunk_size: int) -> jax.Array:
+    """Per-chunk sums of squares over a chunk-ALIGNED flat buffer — the
+    per-tensor output half of ``multi_tensor_l2norm_kernel.cu:117-180``:
+    with aligned packing every chunk belongs to one tensor, so a segment
+    add over ``AlignedMeta.chunk_ids`` turns this ``(n_chunks,)`` table
+    into per-tensor norms.  The table rides SMEM like the CUDA kernel's
+    per-block ``output_per_tensor`` partials."""
+    n = flat.shape[0]
+    n_chunks = n // chunk_size
+    br = _block(chunk_size)
+    return pl.pallas_call(
+        _sumsq_per_chunk_kernel,
+        grid=(n_chunks,),
+        in_specs=[pl.BlockSpec(br, lambda i: (i, 0))],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        out_shape=sds((n_chunks,), jnp.float32, flat),
+        interpret=not on_tpu(),
+    )(_view2d(flat))
+
+
 @functools.partial(jax.jit, static_argnames=("chunk_size",))
 def packed_sumsq(flat: jax.Array, chunk_size: int) -> jax.Array:
     """Total sum of squares over the flat buffer — the two-kernel reduction

@@ -90,9 +90,14 @@ def adam_step(p: jax.Array, m: jax.Array, v: jax.Array, g: jax.Array,
 
 
 class FusedAdamState(NamedTuple):
+    """``step`` is the global schedule counter; ``leaf_step`` holds one
+    scalar count per param leaf — the analog of the reference's per-param
+    ``state['step']`` (``fused_adam.py:119-125``), so params added
+    mid-training (``Amp.add_params``) start their bias correction at 0."""
     step: jax.Array
     m: Any
     v: Any
+    leaf_step: Any
 
 
 def fused_adam(learning_rate=1e-3, beta1: float = 0.9, beta2: float = 0.999,
@@ -113,7 +118,9 @@ def fused_adam(learning_rate=1e-3, beta1: float = 0.9, beta2: float = 0.999,
         zeros = lambda t: jax.tree.map(
             lambda x: jnp.zeros(x.shape, jnp.float32), t)
         return FusedAdamState(step=jnp.zeros((), jnp.int32),
-                              m=zeros(params), v=zeros(params))
+                              m=zeros(params), v=zeros(params),
+                              leaf_step=jax.tree.map(
+                                  lambda x: jnp.zeros((), jnp.int32), params))
 
     def update(grads, state, params=None):
         if params is None:
@@ -125,20 +132,24 @@ def fused_adam(learning_rate=1e-3, beta1: float = 0.9, beta2: float = 0.999,
         ms = treedef.flatten_up_to(state.m)
         vs = treedef.flatten_up_to(state.v)
         gs = treedef.flatten_up_to(grads)
-        updates, new_m, new_v = [], [], []
-        for p, m, v, g in zip(ps, ms, vs, gs):
+        ss = treedef.flatten_up_to(state.leaf_step)
+        updates, new_m, new_v, new_s = [], [], [], []
+        for p, m, v, g, s in zip(ps, ms, vs, gs, ss):
+            s = s + 1
             new_p, nm, nv = adam_step(
                 p, m, v, g, lr=lr, beta1=beta1, beta2=beta2, eps=eps,
-                step=step, scale=scale, weight_decay=weight_decay,
+                step=s, scale=scale, weight_decay=weight_decay,
                 eps_mode=eps_mode, bias_correction=bias_correction)
             updates.append((new_p.astype(jnp.float32)
                             - p.astype(jnp.float32)).astype(p.dtype))
             new_m.append(nm)
             new_v.append(nv)
+            new_s.append(s)
         return (jax.tree.unflatten(treedef, updates),
                 FusedAdamState(step=step,
                                m=jax.tree.unflatten(treedef, new_m),
-                               v=jax.tree.unflatten(treedef, new_v)))
+                               v=jax.tree.unflatten(treedef, new_v),
+                               leaf_step=jax.tree.unflatten(treedef, new_s)))
 
     return optax.GradientTransformation(init, update)
 

@@ -32,25 +32,44 @@ from apex_tpu.ops import use_pallas
 
 
 class FusedLAMBState(NamedTuple):
+    """``step`` is the global schedule counter; ``leaf_step`` holds one
+    scalar count per param leaf (reference per-param ``state['step']``
+    semantics, ``fused_adam.py:119-125``) so params added mid-training
+    start their bias correction at 0."""
     step: jax.Array
     m: Any
     v: Any
+    leaf_step: Any
 
 
 def _within_pallas_capacity(ps) -> bool:
     """True when the whole tree fits the Pallas path's chunk-table budget
     (MAX_CHUNKS chunks of at most LAMB_CHUNK_MAX elements, ~2.1 B params);
-    larger trees take the jnp path instead of failing Mosaic compilation."""
-    from apex_tpu.ops.pallas.lamb_kernels import LAMB_CHUNK_MAX, MAX_CHUNKS
-    total = sum(int(np.prod(p.shape)) if p.shape else 1 for p in ps)
-    return total <= MAX_CHUNKS * LAMB_CHUNK_MAX
+    larger trees take the jnp path instead of failing Mosaic compilation.
+
+    Bounds the chunk COUNT as well as the element total: aligned packing
+    gives every leaf at least one chunk, so a tree of >MAX_CHUNKS tiny
+    leaves would blow the per-chunk SMEM tables (decay/bc/sumsq) even
+    though its element total is small."""
+    from apex_tpu.ops.pallas.lamb_kernels import (
+        LAMB_CHUNK, LAMB_CHUNK_MAX, MAX_CHUNKS)
+    sizes = [int(np.prod(p.shape)) if p.shape else 1 for p in ps]
+    total = sum(sizes)
+    if total > MAX_CHUNKS * LAMB_CHUNK_MAX:
+        return False
+    # same chunk-growth formula as _pallas_lamb_update
+    chunk = LAMB_CHUNK * max(1, -(-total // (LAMB_CHUNK * MAX_CHUNKS)))
+    n_chunks = sum(-(-s // chunk) for s in sizes)
+    return n_chunks <= MAX_CHUNKS
 
 
 def _pallas_lamb_update(gs32, ps, ms, vs, *, lr, beta1, beta2, eps,
                         weight_decay, clip, bc1, bc2):
     """Whole-tree two-stage LAMB via the Pallas kernels
-    (:mod:`apex_tpu.ops.pallas.lamb_kernels`).  Returns flat per-leaf lists
-    ``(deltas, new_m, new_v)``."""
+    (:mod:`apex_tpu.ops.pallas.lamb_kernels`).  ``bc1``/``bc2`` are
+    per-tensor ``(n_tensors,)`` bias-correction factors (resolved to
+    per-chunk tables through ``AlignedMeta.chunk_ids``).  Returns flat
+    per-leaf lists ``(deltas, new_m, new_v)``."""
     from apex_tpu.ops.packing import pack_aligned, pack_into, unpack_aligned
     from apex_tpu.ops.pallas.lamb_kernels import (
         LAMB_CHUNK, MAX_CHUNKS, packed_lamb_stage1, packed_lamb_stage2)
@@ -73,16 +92,14 @@ def _pallas_lamb_update(gs32, ps, ms, vs, *, lr, beta1, beta2, eps,
     u_flat, new_m_flat, new_v_flat = packed_lamb_stage1(
         g_flat, p_flat, m_flat, v_flat, decay,
         beta1=beta1, beta2=beta2, eps=eps, inv_scale=1.0 / clip,
-        bc1=bc1, bc2=bc2, chunk_size=chunk)
+        bc1=bc1[ids], bc2=bc2[ids], chunk_size=chunk)
 
-    # Per-tensor ‖p‖ / ‖update‖ between the stages: per-chunk partial sums
-    # reduced by tensor id (the per-tensor output of multi_tensor_l2norm
-    # feeding lamb stage 2 in the reference).
-    n_tensors = len(meta.shapes)
-    chunk_p = jnp.square(p_flat.reshape(n_chunks, chunk)).sum(axis=1)
-    chunk_u = jnp.square(u_flat.reshape(n_chunks, chunk)).sum(axis=1)
-    p_norm = jnp.sqrt(jnp.zeros((n_tensors,), jnp.float32).at[ids].add(chunk_p))
-    u_norm = jnp.sqrt(jnp.zeros((n_tensors,), jnp.float32).at[ids].add(chunk_u))
+    # Per-tensor ‖p‖ / ‖update‖ between the stages: the fused per-chunk
+    # sumsq kernel segment-reduced by tensor id (the per-tensor output of
+    # multi_tensor_l2norm feeding lamb stage 2 in the reference).
+    from apex_tpu.ops.multi_tensor import per_tensor_sumsq_from_packed
+    p_norm = jnp.sqrt(per_tensor_sumsq_from_packed(p_flat, meta))
+    u_norm = jnp.sqrt(per_tensor_sumsq_from_packed(u_flat, meta))
     ratio_t = jnp.where((p_norm > 0) & (u_norm > 0),
                         p_norm / jnp.maximum(u_norm, 1e-38), 1.0)
     chunk_ratio = lr * ratio_t[ids]
@@ -109,7 +126,9 @@ def fused_lamb(learning_rate=1e-3, beta1: float = 0.9, beta2: float = 0.999,
         zeros = lambda t: jax.tree.map(
             lambda x: jnp.zeros(x.shape, jnp.float32), t)
         return FusedLAMBState(step=jnp.zeros((), jnp.int32),
-                              m=zeros(params), v=zeros(params))
+                              m=zeros(params), v=zeros(params),
+                              leaf_step=jax.tree.map(
+                                  lambda x: jnp.zeros((), jnp.int32), params))
 
     def update(grads, state, params=None):
         if params is None:
@@ -122,15 +141,20 @@ def fused_lamb(learning_rate=1e-3, beta1: float = 0.9, beta2: float = 0.999,
         ps = treedef.flatten_up_to(params)
         ms = treedef.flatten_up_to(state.m)
         vs = treedef.flatten_up_to(state.v)
+        ss = [s + 1 for s in treedef.flatten_up_to(state.leaf_step)]
+        new_leaf_step = jax.tree.unflatten(treedef, ss)
 
         gs32 = [g.astype(jnp.float32) / jnp.asarray(scale, jnp.float32)
                 for g in gs]
 
+        # Per-tensor bias correction from the per-leaf step counts.
+        steps_f = jnp.stack([s.astype(jnp.float32) for s in ss]) \
+            if ss else jnp.zeros((0,), jnp.float32)
         if bias_correction:
-            bc1_ = 1.0 - jnp.power(beta1, step.astype(jnp.float32))
-            bc2_ = 1.0 - jnp.power(beta2, step.astype(jnp.float32))
+            bc1_ = 1.0 - jnp.power(beta1, steps_f)
+            bc2_ = 1.0 - jnp.power(beta2, steps_f)
         else:
-            bc1_ = bc2_ = jnp.asarray(1.0, jnp.float32)
+            bc1_ = bc2_ = jnp.ones_like(steps_f)
 
         # Stage-1 global-norm clip factor (lamb_stage_1.cu
         # clipped_global_norm); shared by both execution paths (aligned-pack
@@ -150,12 +174,12 @@ def fused_lamb(learning_rate=1e-3, beta1: float = 0.9, beta2: float = 0.999,
                     FusedLAMBState(
                         step=step,
                         m=jax.tree.unflatten(treedef, new_ms),
-                        v=jax.tree.unflatten(treedef, new_vs)))
-
-        bc1, bc2 = bc1_, bc2_
+                        v=jax.tree.unflatten(treedef, new_vs),
+                        leaf_step=new_leaf_step))
 
         updates, new_m, new_v = [], [], []
-        for p, m, v, g in zip(ps, ms, vs, gs32):
+        for i, (p, m, v, g) in enumerate(zip(ps, ms, vs, gs32)):
+            bc1, bc2 = bc1_[i], bc2_[i]
             p32 = p.astype(jnp.float32)
             g = g / clip
             m = beta1 * m + (1.0 - beta1) * g
@@ -175,7 +199,8 @@ def fused_lamb(learning_rate=1e-3, beta1: float = 0.9, beta2: float = 0.999,
         return (jax.tree.unflatten(treedef, updates),
                 FusedLAMBState(step=step,
                                m=jax.tree.unflatten(treedef, new_m),
-                               v=jax.tree.unflatten(treedef, new_v)))
+                               v=jax.tree.unflatten(treedef, new_v),
+                               leaf_step=new_leaf_step))
 
     return optax.GradientTransformation(init, update)
 

@@ -77,6 +77,10 @@ def main():
     if args.seq_parallel:
         from jax.sharding import Mesh, PartitionSpec as P
         n = min(args.devices, len(jax.devices()))
+        if l % n != 0:
+            raise SystemExit(
+                f"--seq-parallel requires --seq-len divisible by the "
+                f"device count: got seq_len={l}, devices={n}")
         mesh = Mesh(np.array(jax.devices()[:n]), ("seq",))
         cfg_sp = dataclasses.replace(cfg, seq_axis_name="seq")
         model = GPTModel(cfg_sp)

@@ -130,6 +130,29 @@ def test_l2norm(monkeypatch, mode):
     np.testing.assert_allclose(np.asarray(per), ref_per, rtol=1e-5)
 
 
+@pytest.mark.parametrize("chunk", [2048 * 32, 4096])
+def test_l2norm_per_tensor_fused_boundaries(monkeypatch, chunk):
+    """The fused per-tensor path (aligned pack + per-chunk sumsq +
+    segment reduce): sizes straddling chunk boundaries, a scalar, and a
+    mixed-dtype list — must agree with per-leaf numpy norms and with the
+    jnp path."""
+    monkeypatch.setenv("APEX_TPU_KERNELS", "pallas")
+    xs = make_list([1, chunk - 1, chunk, chunk + 1, 3 * chunk + 17],
+                   jnp.float32, seed=3)
+    xs.append(jnp.asarray(2.5, jnp.float32))          # scalar leaf
+    xs.append(jnp.ones((257,), jnp.bfloat16) * 0.5)   # second dtype group
+    total, per = multi_tensor_l2norm(chunk, [xs], per_tensor=True)
+    ref_per = np.array([np.linalg.norm(np.asarray(x, np.float32).ravel())
+                        for x in xs])
+    np.testing.assert_allclose(np.asarray(per), ref_per, rtol=1e-5)
+    np.testing.assert_allclose(float(total), np.sqrt((ref_per ** 2).sum()),
+                               rtol=1e-5)
+    monkeypatch.setenv("APEX_TPU_KERNELS", "jnp")
+    total_j, per_j = multi_tensor_l2norm(chunk, [xs], per_tensor=True)
+    np.testing.assert_allclose(np.asarray(per), np.asarray(per_j), rtol=1e-6)
+    np.testing.assert_allclose(float(total), float(total_j), rtol=1e-6)
+
+
 def test_mixed_dtype_list_groups(monkeypatch):
     monkeypatch.setenv("APEX_TPU_KERNELS", "pallas")
     xs = [jnp.ones((10,), jnp.float32), jnp.ones((20,), jnp.bfloat16),

@@ -112,6 +112,51 @@ def test_add_params_preserves_existing_optimizer_state():
                            np.asarray(state2.master_params["g1"]["w"]))
 
 
+def _decoupled_loss(params, x):
+    # each group's gradient is independent of the others
+    return sum(jnp.sum(jnp.square(x @ params[k]["w"])) for k in sorted(params))
+
+
+@pytest.mark.parametrize("opt", ["adam", "lamb"])
+def test_add_params_new_group_starts_at_step_zero(opt):
+    """Reference semantics (fused_adam.py:119-125 state per param /
+    add_param_group): a group added mid-training starts bias correction at
+    step 0 — its first update must be bit-identical to a fresh optimizer's
+    first step on the same gradients."""
+    from apex_tpu.optimizers import FusedAdam, FusedLAMB
+    # LAMB's global-norm clip couples groups; disable it so the new
+    # group's update depends only on its own gradients
+    make = FusedAdam if opt == "adam" else \
+        (lambda lr: FusedLAMB(lr=lr, max_grad_norm=0.0))
+    rng = np.random.RandomState(1)
+    w0 = jnp.asarray(rng.randn(8, 8).astype(np.float32))
+    w1 = jnp.asarray(rng.randn(8, 8).astype(np.float32))
+    x = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+
+    a = amp.initialize(optimizer=make(lr=1e-2), opt_level="O2", verbosity=0)
+    state = a.init({"g0": {"w": w0}})
+    step = jax.jit(amp.make_train_step(a, _decoupled_loss))
+    for _ in range(3):
+        state, _ = step(state, x)
+    state = a.add_params(state, {"g1": {"w": w1}})
+    state_after, _ = step(state, x)
+
+    # per-leaf counters: existing group at 4, new group at 1
+    ls = state_after.opt_state.leaf_step
+    assert int(ls["g0"]["w"]) == 4
+    assert int(ls["g1"]["w"]) == 1
+    assert int(state_after.opt_state.step) == 4  # global schedule counter
+
+    # fresh optimizer, first step on g1 alone: identical update
+    b = amp.initialize(optimizer=make(lr=1e-2), opt_level="O2", verbosity=0)
+    fresh = b.init({"g1": {"w": w1}})
+    fresh_after, _ = jax.jit(amp.make_train_step(b, _decoupled_loss))(
+        fresh, x)
+    np.testing.assert_array_equal(
+        np.asarray(state_after.master_params["g1"]["w"]),
+        np.asarray(fresh_after.master_params["g1"]["w"]))
+
+
 def test_add_params_rejects_overlap_and_nondict():
     a = amp.initialize(optimizer=optax.sgd(0.1), opt_level="O2",
                        verbosity=0)

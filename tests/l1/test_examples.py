@@ -32,6 +32,7 @@ CASES = {
     "long_context_attention.py": ["--seq-len", "512", "--heads", "2",
                                   "--head-dim", "32", "--force-cpu"],
     "pipeline_moe.py": ["--mode", "ep", "--steps", "2"],
+    "pipeline_moe.py --mode pp": ["--mode", "pp", "--steps", "2"],
     "gpt_lm.py": ["--steps", "2", "--seq-len", "64", "--batch-size", "2",
                   "--seq-parallel", "--devices", "4", "--force-cpu"],
 }
@@ -47,7 +48,8 @@ def test_example_runs(script):
                      if "xla_force_host_platform_device_count" not in f)
     env["XLA_FLAGS"] = flags
     out = subprocess.run(
-        [sys.executable, str(REPO / "examples" / script)] + CASES[script],
+        [sys.executable, str(REPO / "examples" / script.split()[0])]
+        + CASES[script],
         capture_output=True, text=True, timeout=900, env=env,
         cwd=str(REPO))
     assert out.returncode == 0, (script, out.stdout[-2000:],
