@@ -160,24 +160,29 @@ def multi_tensor_l2norm(
 
     if use_pallas() and ker.chunk_supported(chunk_size):
         if per_tensor:
-            # SMEM table bound checked for ALL dtype groups up front (same
-            # chunk-count formula as pack_aligned) so no pallas work is
-            # done and then discarded when a later group overflows.
-            groups = packing.group_by_dtype(ins)
-            fits = all(
-                sum(-(-(int(np.prod(ins[i].shape)) if ins[i].shape else 1)
-                      // chunk_size) for i in idxs) <= ker.MAX_SUMSQ_CHUNKS
-                for idxs in groups.values())
-            if fits:
-                per_sq: List[Optional[jax.Array]] = [None] * len(ins)
-                for dtype, idxs in groups.items():
-                    flat, meta = packing.pack_aligned(
-                        [ins[i] for i in idxs], chunk_size)
+            # Per dtype group, fused when it pays: two gates (decided from
+            # pack_aligned's own chunk-count formula, BEFORE any packing
+            # work) — the SMEM per-chunk table bound, and padding waste.
+            # Every leaf pads to a whole chunk, so a small-leaf-dominated
+            # group would read far more HBM fused than the per-leaf jnp
+            # reductions; cap the padded traffic at 2x the real elements.
+            per_sq: List[Optional[jax.Array]] = [None] * len(ins)
+            for dtype, idxs in packing.group_by_dtype(ins).items():
+                group = [ins[i] for i in idxs]
+                sizes = packing.leaf_sizes(group)
+                n_chunks = packing.aligned_chunk_count(sizes, chunk_size)
+                if (n_chunks <= ker.MAX_SUMSQ_CHUNKS
+                        and n_chunks * chunk_size <= 2 * sum(sizes)):
+                    flat, meta = packing.pack_aligned(group, chunk_size)
                     sums = per_tensor_sumsq_from_packed(flat, meta)
                     for j, i in enumerate(idxs):
                         per_sq[i] = sums[j]
-                per = jnp.stack(per_sq)
-                return jnp.sqrt(per.sum()), jnp.sqrt(per)
+                else:
+                    for i in idxs:
+                        per_sq[i] = jnp.sum(
+                            jnp.square(ins[i].astype(jnp.float32)))
+            per = jnp.stack(per_sq)
+            return jnp.sqrt(per.sum()), jnp.sqrt(per)
         else:
             total = jnp.zeros((), jnp.float32)
             for dtype, idxs in packing.group_by_dtype(ins).items():

@@ -121,6 +121,18 @@ class AlignedMeta(NamedTuple):
     dtype: Any
 
 
+def leaf_sizes(tensors: Sequence[jax.Array]) -> List[int]:
+    """Element counts as :func:`pack_aligned` sees them (scalars count 1)."""
+    return [int(np.prod(t.shape)) if t.shape else 1 for t in tensors]
+
+
+def aligned_chunk_count(sizes: Sequence[int], chunk_size: int) -> int:
+    """Number of chunks :func:`pack_aligned` will produce — THE formula the
+    capacity predicates (SMEM per-chunk tables) must share with the packer
+    so they can never disagree with the actual layout."""
+    return sum(-(-s // chunk_size) for s in sizes)
+
+
 def pack_aligned(tensors: Sequence[jax.Array],
                  chunk_size: int) -> Tuple[jax.Array, AlignedMeta]:
     """Concatenate raveled tensors, padding EACH to a chunk multiple.

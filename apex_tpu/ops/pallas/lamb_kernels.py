@@ -45,6 +45,12 @@ MAX_CHUNKS = 32768
 LAMB_CHUNK_MAX = 64 * 1024
 
 
+def grown_chunk(total: int) -> int:
+    """Chunk size grown so at most MAX_CHUNKS chunks cover ``total``
+    elements — THE formula shared by the LAMB driver's packer and its
+    capacity predicate (they must agree or an over-budget tree reaches
+    Mosaic and fails compilation)."""
+    return LAMB_CHUNK * max(1, -(-total // (LAMB_CHUNK * MAX_CHUNKS)))
 
 
 def _stage1_kernel(scalars_ref, decay_ref, bc1_ref, bc2_ref, g_ref, p_ref,

@@ -51,16 +51,14 @@ def _within_pallas_capacity(ps) -> bool:
     gives every leaf at least one chunk, so a tree of >MAX_CHUNKS tiny
     leaves would blow the per-chunk SMEM tables (decay/bc/sumsq) even
     though its element total is small."""
+    from apex_tpu.ops.packing import aligned_chunk_count, leaf_sizes
     from apex_tpu.ops.pallas.lamb_kernels import (
-        LAMB_CHUNK, LAMB_CHUNK_MAX, MAX_CHUNKS)
-    sizes = [int(np.prod(p.shape)) if p.shape else 1 for p in ps]
+        LAMB_CHUNK_MAX, MAX_CHUNKS, grown_chunk)
+    sizes = leaf_sizes(ps)
     total = sum(sizes)
     if total > MAX_CHUNKS * LAMB_CHUNK_MAX:
         return False
-    # same chunk-growth formula as _pallas_lamb_update
-    chunk = LAMB_CHUNK * max(1, -(-total // (LAMB_CHUNK * MAX_CHUNKS)))
-    n_chunks = sum(-(-s // chunk) for s in sizes)
-    return n_chunks <= MAX_CHUNKS
+    return aligned_chunk_count(sizes, grown_chunk(total)) <= MAX_CHUNKS
 
 
 def _pallas_lamb_update(gs32, ps, ms, vs, *, lr, beta1, beta2, eps,
@@ -70,16 +68,16 @@ def _pallas_lamb_update(gs32, ps, ms, vs, *, lr, beta1, beta2, eps,
     per-tensor ``(n_tensors,)`` bias-correction factors (resolved to
     per-chunk tables through ``AlignedMeta.chunk_ids``).  Returns flat
     per-leaf lists ``(deltas, new_m, new_v)``."""
-    from apex_tpu.ops.packing import pack_aligned, pack_into, unpack_aligned
+    from apex_tpu.ops.packing import (
+        leaf_sizes, pack_aligned, pack_into, unpack_aligned)
     from apex_tpu.ops.pallas.lamb_kernels import (
-        LAMB_CHUNK, MAX_CHUNKS, packed_lamb_stage1, packed_lamb_stage2)
+        grown_chunk, packed_lamb_stage1, packed_lamb_stage2)
 
     # Scale the chunk so the SMEM chunk->scalar tables stay bounded (~128 KiB
     # against the ~1 MiB SMEM budget) regardless of model size.  Callers
     # guarantee total <= MAX_CHUNKS * LAMB_CHUNK_MAX so the grown chunk
-    # stays within the VMEM budget (see _pallas_capacity).
-    total = sum(int(np.prod(p.shape)) if p.shape else 1 for p in ps)
-    chunk = LAMB_CHUNK * max(1, -(-total // (LAMB_CHUNK * MAX_CHUNKS)))
+    # stays within the VMEM budget (see _within_pallas_capacity).
+    chunk = grown_chunk(sum(leaf_sizes(ps)))
 
     g_flat, meta = pack_aligned(gs32, chunk)
     p_flat = pack_into([p.astype(jnp.float32) for p in ps], meta)

@@ -1,0 +1,55 @@
+# Sphinx configuration for the apex_tpu documentation.
+#
+# Role parity with the reference's docs/source/conf.py (a sphinx-quickstart
+# autodoc setup pointing at the package root); written for this tree rather
+# than copied.  Build with ``make docs`` at the repo root or
+# ``sphinx-build -W docs/source docs/build``.
+
+import os
+import sys
+
+# repo root on sys.path so autodoc can import apex_tpu without an install
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), "..", "..")))
+
+project = "apex_tpu"
+copyright = "2026, apex_tpu authors"
+author = "apex_tpu authors"
+release = "0.1"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.autosummary",
+    "sphinx.ext.napoleon",       # google/numpy docstring styles
+    "sphinx.ext.viewcode",
+    "sphinx.ext.intersphinx",
+]
+
+# zero-egress environments: resolve intersphinx targets only if an
+# inventory is available locally (none by default)
+intersphinx_mapping = {}
+
+templates_path = []
+exclude_patterns = []
+
+# jax/flax/optax are heavyweight; autodoc imports the real ones (they are
+# installed here).  Mock nothing by default; add names if the doc build
+# environment lacks them.
+autodoc_mock_imports = []
+autodoc_member_order = "bysource"
+autosummary_generate = False
+
+napoleon_google_docstring = True
+napoleon_numpy_docstring = True
+
+try:  # rtd theme if present, stock alabaster otherwise
+    import sphinx_rtd_theme  # noqa: F401
+    html_theme = "sphinx_rtd_theme"
+except ImportError:
+    html_theme = "alabaster"
+
+html_static_path = []
+
+# -W builds: warnings are errors; keep the nitpick list empty so missing
+# cross-references surface instead of accumulating
+nitpicky = False

@@ -22,6 +22,11 @@ CASES = {
     "mnist_amp.py": ["--steps", "2", "--batch-size", "16"],
     "imagenet_main_amp.py": ["--steps", "2", "--batch-size", "2",
                              "--image-size", "32", "--arch", "resnet18"],
+    # real data: train one epoch on sklearn digits + full validate() loop
+    # (prec@1/prec@5 path, reference main_amp.py:439-489)
+    "imagenet_main_amp.py --data digits": [
+        "--data", "digits", "--epochs", "1", "--batch-size", "256",
+        "--image-size", "8", "--arch", "resnet18"],
     "bert_pretraining.py": ["--steps", "2", "--batch-size", "2",
                             "--seq-len", "32", "--size", "tiny"],
     "dcgan_main_amp.py": ["--steps", "2", "--batch-size", "4"],

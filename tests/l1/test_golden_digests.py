@@ -1,0 +1,83 @@
+"""Stored-baseline digest comparison — the reference ``compare.py
+--use_baseline`` mode (``tests/L1/common/compare.py:36-63``): every run's
+per-iteration losses are diffed against a digest file saved from an earlier
+run/build, so numerical drift across commits fails the suite until the
+baseline is intentionally regenerated:
+
+    APEX_TPU_REGEN_GOLDEN=1 python -m pytest tests/l1/test_golden_digests.py
+
+The baseline is platform-specific (XLA:CPU vs XLA:TPU produce different —
+each internally deterministic — float sequences); configs are compared only
+on the platform they were recorded on and skipped elsewhere.
+"""
+
+import json
+import os
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from tests.l1.harness import run_workload
+
+GOLDEN = Path(__file__).with_name("golden_digests.json")
+
+#: config name -> run_workload kwargs.  One per distinct numerics regime:
+#: pure fp32, O1 cast ops, O2 master weights + fused optimizer, O3 static
+#: scale, BN-in-fp32, and the overflow-skip state machine.
+CONFIGS = {
+    "o0_fp32": dict(opt_level="O0"),
+    "o1_dynamic": dict(opt_level="O1", loss_scale="dynamic"),
+    "o2_dynamic_fused_adam": dict(opt_level="O2", loss_scale="dynamic",
+                                  fused_adam=True),
+    "o3_static128": dict(opt_level="O3", loss_scale=128.0),
+    "o2_bn_keep_fp32": dict(opt_level="O2", keep_batchnorm_fp32=True,
+                            with_bn=True),
+    "o2_overflow_inject": dict(opt_level="O2", loss_scale="dynamic",
+                               inject_inf_at=2),
+}
+
+
+def _record(cfg_kwargs):
+    d = run_workload(**cfg_kwargs)
+    return {
+        "fingerprint": d["fingerprint"],
+        "losses": [float(x) for x in d["losses"]],
+        "scales": [float(x) for x in d["scales"]],
+        "overflows": [bool(x) for x in d["overflows"]],
+    }
+
+
+def _load():
+    if not GOLDEN.exists():
+        return {}
+    return json.loads(GOLDEN.read_text())
+
+
+@pytest.mark.parametrize("name", sorted(CONFIGS))
+def test_golden_digest(name):
+    platform = jax.devices()[0].platform
+    stored = _load()
+    if os.environ.get("APEX_TPU_REGEN_GOLDEN"):
+        stored.setdefault(platform, {})[name] = _record(CONFIGS[name])
+        GOLDEN.write_text(json.dumps(stored, indent=1, sort_keys=True)
+                          + "\n")
+        pytest.skip(f"regenerated baseline for {platform}/{name}")
+    if platform not in stored or name not in stored[platform]:
+        pytest.skip(f"no stored baseline for platform {platform!r}; "
+                    f"regenerate with APEX_TPU_REGEN_GOLDEN=1")
+    want = stored[platform][name]
+    got = _record(CONFIGS[name])
+    assert got["fingerprint"] == want["fingerprint"], (
+        f"numerical drift vs stored baseline for {name}:\n"
+        f"  stored losses: {want['losses']}\n"
+        f"  current losses: {got['losses']}\n"
+        f"  stored scales: {want['scales']}\n"
+        f"  current scales: {got['scales']}\n"
+        "If this change is intentional, regenerate with "
+        "APEX_TPU_REGEN_GOLDEN=1 and commit the new golden_digests.json.")
+    # redundant with the fingerprint, but gives a readable diff on failure
+    np.testing.assert_array_equal(got["losses"], want["losses"])
+    np.testing.assert_array_equal(got["scales"], want["scales"])
+    assert got["overflows"] == want["overflows"]

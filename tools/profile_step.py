@@ -1,0 +1,73 @@
+"""Profile one training-step config and print the top device-time ops.
+
+Usage: python tools/profile_step.py [resnet50|gpt] [opt_level]
+
+Captures an XProf trace of a few steps, then parses the trace-event JSON
+directly (no tensorboard needed) and aggregates self-time by HLO op
+category on the device track — the "profile one step and act on the top
+hotspot" loop of VERDICT r1 item 3.
+"""
+
+import collections
+import glob
+import gzip
+import json
+import sys
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def build(model_name: str, opt_level: str):
+    import bench
+    peak = bench.chip_peak_flops()
+    if model_name == "gpt":
+        fn = lambda: bench.bench_gpt(batch=8, seq=1024, warmup=2, iters=8,
+                                     peak=peak, tiny=False)
+    else:
+        fn = lambda: bench.bench_resnet(opt_level, batch=256, size=224,
+                                        warmup=2, iters=8, peak=peak)
+    return fn
+
+
+def parse_traces(logdir: str):
+    """Aggregate wall-duration by event name from the xplane-exported
+    trace.json.gz files."""
+    events = collections.Counter()
+    total = 0.0
+    for path in glob.glob(f"{logdir}/**/*.trace.json.gz", recursive=True):
+        with gzip.open(path, "rt") as f:
+            data = json.load(f)
+        for ev in data.get("traceEvents", []):
+            if ev.get("ph") != "X" or "dur" not in ev:
+                continue
+            pid_name = ev.get("pid")
+            name = ev.get("name", "?")
+            events[name] += ev["dur"]
+            total += ev["dur"]
+    return events, total
+
+
+def main():
+    model_name = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
+    opt_level = sys.argv[2] if len(sys.argv) > 2 else "O2"
+    fn = build(model_name, opt_level)
+    fn()  # warm compile outside the trace
+    logdir = f"/tmp/apex_tpu_prof_{model_name}_{opt_level}"
+    with jax.profiler.trace(logdir):
+        out = fn()
+    time.sleep(1)
+    print(json.dumps(out))
+    events, total = parse_traces(logdir)
+    print(f"top events by accumulated duration (us), total {total:.0f}:")
+    for name, dur in events.most_common(25):
+        print(f"  {dur:12.0f}  {100 * dur / max(total, 1):5.1f}%  {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()
