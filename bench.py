@@ -151,8 +151,40 @@ def bench_gpt(batch: int, seq: int, warmup: int, iters: int, peak: float,
             "batch": batch, "seq": seq, "params": n_params}
 
 
+def _backend_or_die(timeout_s: float = 240.0):
+    """Device enumeration with a watchdog: a wedged tunnel lease blocks
+    PJRT client init forever (make_c_api_client) with no error — better to
+    fail fast with a diagnosis than hang past the driver's timeout."""
+    import threading
+    done = threading.Event()
+    out = {}
+
+    def probe():
+        try:
+            out["devices"] = jax.devices()
+        except BaseException as e:  # re-raised in the caller
+            out["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    if done.wait(timeout_s):
+        if "error" in out:
+            raise out["error"]
+    else:
+        import os
+        import sys
+        print(f"bench: TPU backend init blocked >{timeout_s:.0f}s "
+              "(stale pool lease / dead relay — see "
+              "make_c_api_client); no metrics can be measured",
+              file=sys.stderr)
+        os._exit(3)
+    return out["devices"]
+
+
 def main():
-    platform = jax.devices()[0].platform
+    platform = _backend_or_die()[0].platform
     on_tpu = platform == "tpu"
     peak = chip_peak_flops() if on_tpu else None  # MFU only meaningful on chip
     # Real configs on TPU; tiny stand-ins on CPU so the script stays

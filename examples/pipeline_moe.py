@@ -118,7 +118,9 @@ def main():
                     + 0.01 * aux.astype(jnp.float32))
 
         def match(path, leaf):
-            return "experts" in path               # router stays replicated
+            # router stays replicated; scalar leaves (per-leaf optimizer
+            # step counters) always replicate
+            return "experts" in path and getattr(leaf, "ndim", 0) >= 1
         data_spec = P("expert")
 
     state = a.init(params)

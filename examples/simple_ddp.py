@@ -47,6 +47,10 @@ def main():
             os.environ["XLA_FLAGS"] = (
                 flags + f" --xla_force_host_platform_device_count={n}"
             ).strip()
+        # config-level pin, not jax.devices("cpu"): the latter still
+        # initializes every registered platform (incl. the TPU plugin,
+        # which can block when the device is held elsewhere)
+        jax.config.update("jax_platforms", "cpu")
 
     from apex_tpu.parallel import DistributedDataParallel
 

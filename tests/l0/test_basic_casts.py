@@ -278,3 +278,12 @@ def test_train_eval_train_transitions_keep_grads_stable(kind):
             .astype(jnp.float32) ** 2))(w)
         np.testing.assert_array_equal(np.asarray(grads[0], np.float32),
                                       np.asarray(ref, np.float32))
+
+
+def test_conv_rejects_unsupported_rank():
+    """Rank-2 input (zero spatial dims) must raise the explicit ValueError,
+    not build a bogus NDHWC dimension-numbers string ("DHW"[-0:] == "DHW")."""
+    with pytest.raises(ValueError, match="spatial"):
+        ops.conv(jnp.ones((2, 3)), jnp.ones((3, 4)))
+    with pytest.raises(ValueError, match="spatial"):
+        ops.conv(jnp.ones((2, 3, 3, 3, 3, 3)), jnp.ones((3, 3, 3, 3, 3, 4)))

@@ -1,0 +1,65 @@
+"""Unit tests for the imagenet example's eval-path helpers (reference
+``main_amp.py:439-489`` ``validate``/``accuracy`` and ``:462-478``
+``adjust_learning_rate``), imported from the example file directly."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+@pytest.fixture(scope="module")
+def ex():
+    spec = importlib.util.spec_from_file_location(
+        "imagenet_main_amp", REPO / "examples" / "imagenet_main_amp.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_accuracy_topk(ex):
+    logits = jnp.asarray([
+        [0.1, 0.9, 0.0, 0.0],   # top1=1
+        [0.8, 0.1, 0.05, 0.05],  # top1=0
+        [0.0, 0.2, 0.3, 0.5],   # top1=3, top2 includes 2
+    ])
+    target = jnp.asarray([1, 1, 2])
+    p1, p2 = ex.accuracy(logits, target, topk=(1, 2))
+    # sample0 correct@1; sample1 correct@2 (class 1 is 2nd); sample2
+    # correct@2 (class 2 is 2nd)
+    np.testing.assert_allclose(float(p1), 100.0 / 3, rtol=1e-6)
+    np.testing.assert_allclose(float(p2), 100.0, rtol=1e-6)
+
+
+def test_lr_schedule_warmup_and_decay(ex):
+    base, len_epoch, warm = 0.1, 10, 5
+    lr = ex.make_lr_schedule(base, len_epoch, warm)
+    # linear warmup: first step tiny, end of warmup = base
+    assert float(lr(0)) == pytest.approx(base * 1 / (warm * len_epoch))
+    assert float(lr(warm * len_epoch - 1)) == pytest.approx(base, rel=1e-6)
+    # reference decay points: factor = epoch//30 (+1 from epoch 80), so
+    # epoch 30 -> base/10, 60 -> base/100, 80 -> base/1000
+    assert float(lr(30 * len_epoch)) == pytest.approx(base * 0.1, rel=1e-6)
+    assert float(lr(60 * len_epoch)) == pytest.approx(base * 0.01, rel=1e-6)
+    assert float(lr(80 * len_epoch)) == pytest.approx(base * 1e-3, rel=1e-5)
+
+
+def test_digits_split_deterministic(ex):
+    tx1, ty1, vx1, vy1, nc1 = ex.load_digits(8)
+    tx2, ty2, vx2, vy2, nc2 = ex.load_digits(8)
+    assert nc1 == nc2 == 10
+    assert len(vy1) == 360 and len(ty1) == 1437
+    np.testing.assert_array_equal(ty1, ty2)
+    np.testing.assert_array_equal(vy1, vy2)
+    # train/val are disjoint rows of the same shuffled corpus: identical
+    # split across calls (checkpoint resume sees the same data)
+    np.testing.assert_array_equal(tx1[0], tx2[0])
+    assert tx1.shape[1:] == (8, 8, 3)
+    # resize path produces the requested spatial size
+    tx3, *_ = ex.load_digits(16)
+    assert tx3.shape[1:] == (16, 16, 3)
