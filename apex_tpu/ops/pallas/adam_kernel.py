@@ -20,6 +20,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops import on_tpu, sds
+from apex_tpu.ops.pallas.multi_tensor_kernels import _LANES, _block, _view2d
 
 #: Flat buffers must be padded to a multiple of this (8 sublanes × 128 lanes
 #: × 8 rows of work per tile keeps every operand a well-formed fp32 tile).
@@ -52,6 +53,94 @@ def _adam_kernel(scalars_ref, p_ref, m_ref, v_ref, g_ref,
     out_v_ref[...] = v.astype(out_v_ref.dtype)
     if rest:  # optional half p_copy (the fused fp16 writeback)
         rest[0][...] = p.astype(rest[0].dtype)
+
+
+def _adam_tree_kernel(scalars_ref, step_ref, p_ref, m_ref, v_ref, g_ref,
+                      out_p_ref, out_m_ref, out_v_ref, *, eps_mode,
+                      with_decay):
+    """Whole-tree variant: per-TENSOR step size (bias correction differs per
+    leaf under per-leaf step counts) resolved through the chunk->tensor
+    table in SMEM, like the LAMB kernels' decay/bc tables.
+
+    ``1-beta`` arrives precomputed (not derived from the rounded f32 betas
+    in-kernel) and the descale is a true division, so the element math is
+    bit-identical to the jnp reference path — the L1 conformance contract.
+    """
+    beta1 = scalars_ref[0]
+    beta2 = scalars_ref[1]
+    om_beta1 = scalars_ref[2]    # 1 - beta1, rounded from the exact value
+    om_beta2 = scalars_ref[3]
+    eps = scalars_ref[4]
+    scale = scalars_ref[5]
+    weight_decay = scalars_ref[6]
+    step_size = step_ref[pl.program_id(0)]
+
+    p = p_ref[...].astype(jnp.float32)
+    m = m_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32) / scale
+    if with_decay:  # trace-time guard, mirroring the jnp path's
+        g = g + weight_decay * p  # `if weight_decay:` (keeps -0.0 grads)
+    m = beta1 * m + om_beta1 * g
+    v = beta2 * v + om_beta2 * g * g
+    if eps_mode == 1:
+        denom = jnp.sqrt(v + eps)
+    else:
+        denom = jnp.sqrt(v) + eps
+    out_p_ref[...] = p - step_size * m / denom
+    out_m_ref[...] = m
+    out_v_ref[...] = v
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("beta1", "beta2", "eps", "weight_decay", "eps_mode",
+                     "chunk_size"))
+def packed_adam_tree(p: jax.Array, m: jax.Array, v: jax.Array, g: jax.Array,
+                     per_chunk_step_size: jax.Array, *, beta1: float,
+                     beta2: float, eps: float, scale, weight_decay: float,
+                     eps_mode: int, chunk_size: int):
+    """One fused Adam pass over a whole chunk-ALIGNED packed tree — the
+    TPU analog of the reference driving ``fused_adam_cuda.adam`` through
+    ``multi_tensor_apply`` (``apex/optimizers/fused_adam.py:126-147``):
+    hundreds of param leaves, one kernel launch, per-tensor bias
+    correction riding the chunk→tensor SMEM table.
+
+    All four buffers fp32, aligned to ``chunk_size`` (zero padding is
+    harmless: 0-grads leave 0-moments and 0-params at 0 up to
+    weight-decay, and padded lanes are sliced away by unpack).  Returns
+    ``(new_p, new_m, new_v)`` flat fp32 buffers.
+    """
+    n = p.shape[0]
+    n_chunks = n // chunk_size
+    br = _block(chunk_size)
+    scalars = jnp.stack([
+        jnp.asarray(beta1, jnp.float32),
+        jnp.asarray(beta2, jnp.float32),
+        jnp.asarray(1.0 - beta1, jnp.float32),  # exact, then rounded once
+        jnp.asarray(1.0 - beta2, jnp.float32),
+        jnp.asarray(eps, jnp.float32),
+        jnp.asarray(scale, jnp.float32),
+        jnp.asarray(weight_decay, jnp.float32),
+    ])
+
+    def spec():
+        return pl.BlockSpec(br, lambda i: (i, 0))
+
+    outs = pl.pallas_call(
+        functools.partial(_adam_tree_kernel, eps_mode=eps_mode,
+                          with_decay=bool(weight_decay)),
+        grid=(n_chunks,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM),
+                  spec(), spec(), spec(), spec()],
+        out_specs=[spec(), spec(), spec()],
+        out_shape=[sds((n // _LANES, _LANES), jnp.float32, p, m, v, g)
+                   for _ in range(3)],
+        interpret=not on_tpu(),
+    )(scalars, per_chunk_step_size.astype(jnp.float32), _view2d(p),
+      _view2d(m), _view2d(v), _view2d(g))
+    return tuple(o.reshape(-1) for o in outs)
 
 
 @functools.partial(

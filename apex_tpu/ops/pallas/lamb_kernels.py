@@ -53,6 +53,21 @@ def grown_chunk(total: int) -> int:
     return LAMB_CHUNK * max(1, -(-total // (LAMB_CHUNK * MAX_CHUNKS)))
 
 
+def tree_within_packed_capacity(ps) -> bool:
+    """Shared capacity predicate for the whole-tree packed optimizer paths
+    (LAMB stages, packed Adam — all stream 7-8 fp32 buffers per grid
+    step): element total bounded by MAX_CHUNKS x LAMB_CHUNK_MAX (VMEM
+    tiles) AND chunk count bounded by MAX_CHUNKS (SMEM per-chunk tables;
+    aligned packing gives every leaf at least one chunk, so many tiny
+    leaves can blow the table even at a small element total)."""
+    from apex_tpu.ops.packing import aligned_chunk_count, leaf_sizes
+    sizes = leaf_sizes(ps)
+    total = sum(sizes)
+    if total > MAX_CHUNKS * LAMB_CHUNK_MAX:
+        return False
+    return aligned_chunk_count(sizes, grown_chunk(total)) <= MAX_CHUNKS
+
+
 def _stage1_kernel(scalars_ref, decay_ref, bc1_ref, bc2_ref, g_ref, p_ref,
                    m_ref, v_ref, u_ref, out_m_ref, out_v_ref):
     beta1 = scalars_ref[0]

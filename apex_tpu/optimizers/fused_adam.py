@@ -89,6 +89,60 @@ def adam_step(p: jax.Array, m: jax.Array, v: jax.Array, g: jax.Array,
     return out
 
 
+def _tree_within_capacity(ps) -> bool:
+    """Same streaming budget as the LAMB packed path; shared predicate."""
+    from apex_tpu.ops.pallas.lamb_kernels import tree_within_packed_capacity
+    return tree_within_packed_capacity(ps)
+
+
+def _packed_tree_update(ps, ms, vs, gs, ss, treedef, step, *, lr, beta1,
+                        beta2, eps, scale, weight_decay, eps_mode,
+                        bias_correction):
+    """Whole-tree fused Adam: ONE kernel pass over the aligned-packed
+    (p, m, v, g) quadruple — the reference's one-multi_tensor_apply-launch
+    economics (``fused_adam.py:126-147``) — with per-tensor step sizes
+    (per-leaf bias correction) through the chunk→tensor SMEM table."""
+    import numpy as _np
+
+    from apex_tpu.ops.packing import (
+        leaf_sizes, pack_aligned, pack_into, unpack_aligned)
+    from apex_tpu.ops.pallas.adam_kernel import packed_adam_tree
+    from apex_tpu.ops.pallas.lamb_kernels import grown_chunk
+
+    new_ss = [s + 1 for s in ss]
+    steps_f = jnp.stack([s.astype(jnp.float32) for s in new_ss])
+    if bias_correction:
+        bc1 = 1.0 - jnp.power(beta1, steps_f)
+        bc2 = 1.0 - jnp.power(beta2, steps_f)
+        step_sizes = lr * jnp.sqrt(bc2) / bc1
+    else:
+        step_sizes = jnp.broadcast_to(jnp.asarray(lr, jnp.float32),
+                                      steps_f.shape)
+
+    chunk = grown_chunk(sum(leaf_sizes(ps)))
+    p_flat, meta = pack_aligned([p.astype(jnp.float32) for p in ps], chunk)
+    m_flat = pack_into(ms, meta)
+    v_flat = pack_into(vs, meta)
+    g_flat = pack_into([g.astype(jnp.float32) for g in gs], meta)
+    ids = jnp.asarray(_np.array(meta.chunk_ids), jnp.int32)
+
+    new_p_flat, new_m_flat, new_v_flat = packed_adam_tree(
+        p_flat, m_flat, v_flat, g_flat, step_sizes[ids], beta1=beta1,
+        beta2=beta2, eps=eps, scale=scale, weight_decay=weight_decay,
+        eps_mode=eps_mode, chunk_size=chunk)
+
+    deltas = unpack_aligned(new_p_flat - p_flat, meta)
+    updates = [d.astype(p.dtype) for d, p in zip(deltas, ps)]
+    return (jax.tree.unflatten(treedef, updates),
+            FusedAdamState(
+                step=step,
+                m=jax.tree.unflatten(treedef, unpack_aligned(new_m_flat,
+                                                             meta)),
+                v=jax.tree.unflatten(treedef, unpack_aligned(new_v_flat,
+                                                             meta)),
+                leaf_step=jax.tree.unflatten(treedef, new_ss)))
+
+
 class FusedAdamState(NamedTuple):
     """``step`` is the global schedule counter; ``leaf_step`` holds one
     scalar count per param leaf — the analog of the reference's per-param
@@ -133,6 +187,24 @@ def fused_adam(learning_rate=1e-3, beta1: float = 0.9, beta2: float = 0.999,
         vs = treedef.flatten_up_to(state.v)
         gs = treedef.flatten_up_to(grads)
         ss = treedef.flatten_up_to(state.leaf_step)
+
+        # Whole-tree packed path: opt-in (APEX_TPU_ADAM_PACKED=1).  Unlike
+        # CUDA, where multi_tensor_apply wins by amortizing launch
+        # overhead, on TPU the per-leaf updates below compile into a
+        # handful of XLA fusions with negligible dispatch cost, while
+        # packing pays a pack/unpack HBM round-trip every step — keep the
+        # persistent-flat representation (FP16Optimizer) for steady-state
+        # packing and this path for when profiling shows the fusion count
+        # itself is the bottleneck.
+        import os
+        if (os.environ.get("APEX_TPU_ADAM_PACKED") == "1" and use_pallas()
+                and ps and _tree_within_capacity(ps)):
+            return _packed_tree_update(
+                ps, ms, vs, gs, ss, treedef, step, lr=lr, beta1=beta1,
+                beta2=beta2, eps=eps, scale=scale,
+                weight_decay=weight_decay, eps_mode=eps_mode,
+                bias_correction=bias_correction)
+
         updates, new_m, new_v, new_s = [], [], [], []
         for p, m, v, g, s in zip(ps, ms, vs, gs, ss):
             s = s + 1

@@ -43,22 +43,10 @@ class FusedLAMBState(NamedTuple):
 
 
 def _within_pallas_capacity(ps) -> bool:
-    """True when the whole tree fits the Pallas path's chunk-table budget
-    (MAX_CHUNKS chunks of at most LAMB_CHUNK_MAX elements, ~2.1 B params);
-    larger trees take the jnp path instead of failing Mosaic compilation.
-
-    Bounds the chunk COUNT as well as the element total: aligned packing
-    gives every leaf at least one chunk, so a tree of >MAX_CHUNKS tiny
-    leaves would blow the per-chunk SMEM tables (decay/bc/sumsq) even
-    though its element total is small."""
-    from apex_tpu.ops.packing import aligned_chunk_count, leaf_sizes
-    from apex_tpu.ops.pallas.lamb_kernels import (
-        LAMB_CHUNK_MAX, MAX_CHUNKS, grown_chunk)
-    sizes = leaf_sizes(ps)
-    total = sum(sizes)
-    if total > MAX_CHUNKS * LAMB_CHUNK_MAX:
-        return False
-    return aligned_chunk_count(sizes, grown_chunk(total)) <= MAX_CHUNKS
+    """Larger-than-budget trees take the jnp path instead of failing Mosaic
+    compilation; see :func:`tree_within_packed_capacity`."""
+    from apex_tpu.ops.pallas.lamb_kernels import tree_within_packed_capacity
+    return tree_within_packed_capacity(ps)
 
 
 def _pallas_lamb_update(gs32, ps, ms, vs, *, lr, beta1, beta2, eps,

@@ -169,3 +169,52 @@ class TestFP16Optimizer:
                                       np.asarray(restored.master))
         assert float(restored.scaler_state.loss_scale) == \
             float(state.scaler_state.loss_scale)
+
+
+def test_packed_tree_update_bitwise_matches_per_leaf(monkeypatch):
+    """The whole-tree packed path (one kernel pass over the aligned pack,
+    per-tensor step sizes via the chunk->tensor table) must be BIT-identical
+    to the per-leaf jnp path — the L1 ext-vs-no-ext conformance contract —
+    across mixed shapes, a scalar leaf, weight decay, and a non-unit scale."""
+    from apex_tpu.optimizers.fused_adam import fused_adam
+
+    rng = np.random.RandomState(7)
+    mk = lambda *s: jnp.asarray(rng.randn(*s).astype(np.float32))
+    params = {"w": mk(17, 9), "b": mk(33), "s": jnp.asarray(0.7, jnp.float32),
+              "t": mk(2, 3, 5)}
+    grads = {"w": mk(17, 9), "b": mk(33), "s": jnp.asarray(0.2, jnp.float32),
+             "t": mk(2, 3, 5)}
+    tx = fused_adam(learning_rate=3e-3, weight_decay=0.01, scale=128.0)
+
+    # both paths under jit: XLA's FMA contraction must apply to both or
+    # neither for a bitwise comparison (training always runs jitted).
+    # Distinct lambdas: jax.jit caches traces by function identity, and the
+    # kernel-path choice is baked in at trace time.
+    monkeypatch.setenv("APEX_TPU_KERNELS", "jnp")
+    state = tx.init(params)
+    u_ref, s_ref = jax.jit(lambda g, s, p: tx.update(g, s, p))(
+        grads, state, params)
+
+    monkeypatch.setenv("APEX_TPU_KERNELS", "pallas")
+    monkeypatch.setenv("APEX_TPU_ADAM_PACKED", "1")
+    # confirm the packed path actually engages (sys.modules: the package
+    # attr "fused_adam" is the function, shadowing the submodule)
+    import sys
+    fa = sys.modules["apex_tpu.optimizers.fused_adam"]
+    called = {}
+    orig = fa._packed_tree_update
+
+    def spy(*a, **k):
+        called["x"] = True
+        return orig(*a, **k)
+
+    monkeypatch.setattr(fa, "_packed_tree_update", spy)
+    u_got, s_got = jax.jit(lambda g, s, p: tx.update(g, s, p))(
+        grads, state, params)
+    assert called, "packed tree path did not engage under pallas mode"
+
+    for r, o in zip(jax.tree.leaves((u_ref, s_ref.m, s_ref.v)),
+                    jax.tree.leaves((u_got, s_got.m, s_got.v))):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(o))
+    assert jax.tree.all(jax.tree.map(
+        lambda a, b: bool((a == b).all()), s_ref.leaf_step, s_got.leaf_step))
