@@ -191,7 +191,7 @@ def main():
     # runnable anywhere (the driver runs it on the real chip).
     if on_tpu:
         rn_args = dict(batch=256, size=224, warmup=5, iters=30)
-        gpt_args = dict(batch=8, seq=1024, warmup=3, iters=20, tiny=False)
+        gpt_args = dict(batch=8, seq=2048, warmup=3, iters=20, tiny=False)
     else:
         rn_args = dict(batch=8, size=64, warmup=1, iters=3)
         gpt_args = dict(batch=2, seq=64, warmup=1, iters=3, tiny=True)

@@ -27,7 +27,8 @@ def build(model_name: str, opt_level: str):
     import bench
     peak = bench.chip_peak_flops()
     if model_name == "gpt":
-        fn = lambda: bench.bench_gpt(batch=8, seq=1024, warmup=2, iters=8,
+        # same config as bench.py's headline GPT entry (keep in sync)
+        fn = lambda: bench.bench_gpt(batch=8, seq=2048, warmup=2, iters=8,
                                      peak=peak, tiny=False)
     else:
         fn = lambda: bench.bench_resnet(opt_level, batch=256, size=224,
