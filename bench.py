@@ -151,10 +151,11 @@ def bench_gpt(batch: int, seq: int, warmup: int, iters: int, peak: float,
             "batch": batch, "seq": seq, "params": n_params}
 
 
-def _backend_or_die(timeout_s: float = 240.0):
-    """Device enumeration with a watchdog: a wedged tunnel lease blocks
-    PJRT client init forever (make_c_api_client) with no error — better to
-    fail fast with a diagnosis than hang past the driver's timeout."""
+def probe_devices(timeout_s: float = 240.0):
+    """``jax.devices()`` under a watchdog: a wedged tunnel lease blocks
+    PJRT client init forever (make_c_api_client) with no error.  Returns
+    the device list, ``None`` on timeout; init *errors* re-raise
+    immediately with their real traceback."""
     import threading
     done = threading.Event()
     out = {}
@@ -169,10 +170,18 @@ def _backend_or_die(timeout_s: float = 240.0):
 
     t = threading.Thread(target=probe, daemon=True)
     t.start()
-    if done.wait(timeout_s):
-        if "error" in out:
-            raise out["error"]
-    else:
+    if not done.wait(timeout_s):
+        return None
+    if "error" in out:
+        raise out["error"]
+    return out["devices"]
+
+
+def _backend_or_die(timeout_s: float = 240.0):
+    """Fail fast with a diagnosis rather than hang past the driver's
+    timeout when the backend is wedged."""
+    devices = probe_devices(timeout_s)
+    if devices is None:
         import os
         import sys
         print(f"bench: TPU backend init blocked >{timeout_s:.0f}s "
@@ -180,7 +189,54 @@ def _backend_or_die(timeout_s: float = 240.0):
               "make_c_api_client); no metrics can be measured",
               file=sys.stderr)
         os._exit(3)
-    return out["devices"]
+    return devices
+
+
+def bench_bert(batch: int, seq: int, warmup: int, iters: int, peak: float,
+               tiny: bool):
+    """BASELINE config 4: BERT-large MLM+NSP pretraining step with
+    FusedLAMB + FusedLayerNorm + flash attention (amp O2)."""
+    import dataclasses
+
+    from apex_tpu import amp
+    from apex_tpu.models.bert import (
+        BertForPreTraining, bert_large, bert_tiny, pretraining_loss)
+    from apex_tpu.optimizers import FusedLAMB
+
+    cfg = bert_tiny() if tiny else dataclasses.replace(bert_large(),
+                                                       remat=True)
+    model = BertForPreTraining(cfg)
+    k = jax.random.split(jax.random.PRNGKey(5), 4)
+    ids = jax.random.randint(k[0], (batch, seq), 0, cfg.vocab_size)
+    mlm_labels = jax.random.randint(k[1], (batch, seq), 0, cfg.vocab_size)
+    mlm_mask = (jax.random.uniform(k[2], (batch, seq)) < 0.15)\
+        .astype(jnp.float32)
+    nsp_labels = jax.random.randint(k[3], (batch,), 0, 2)
+    params = model.init(jax.random.PRNGKey(6), ids[:1, :8])["params"]
+
+    a = amp.initialize(optimizer=FusedLAMB(lr=1e-4), opt_level="O2",
+                       verbosity=0)
+    state = a.init(params)
+
+    def loss_fn(p, ids, mlm_labels, nsp_labels, mlm_mask):
+        mlm_logits, nsp_logits = model.apply({"params": p}, ids)
+        return pretraining_loss(mlm_logits, nsp_logits, mlm_labels,
+                                nsp_labels, mlm_mask)
+
+    step = jax.jit(amp.make_train_step(a, loss_fn), donate_argnums=(0,))
+    args = (ids, mlm_labels, nsp_labels, mlm_mask)
+    compiled = step.lower(state, *args).compile()
+    dt = _time_steps(compiled, state, args, warmup, iters)
+
+    seq_per_sec = batch * iters / dt
+    n_params = sum(int(p.size) for p in jax.tree.leaves(params))
+    flops = step_flops(
+        compiled,
+        fallback=(6.0 * n_params + 12.0 * cfg.num_layers * cfg.hidden_size
+                  * seq) * batch * seq)
+    mfu = round(flops * iters / dt / peak, 4) if peak else None
+    return {"seq_s": round(seq_per_sec, 2), "mfu": mfu, "batch": batch,
+            "seq": seq, "params": n_params}
 
 
 def main():
@@ -192,19 +248,41 @@ def main():
     if on_tpu:
         rn_args = dict(batch=256, size=224, warmup=5, iters=30)
         gpt_args = dict(batch=8, seq=2048, warmup=3, iters=20, tiny=False)
+        bert_args = dict(batch=16, seq=512, warmup=3, iters=15, tiny=False)
     else:
         rn_args = dict(batch=8, size=64, warmup=1, iters=3)
         gpt_args = dict(batch=2, seq=64, warmup=1, iters=3, tiny=True)
+        bert_args = dict(batch=2, seq=64, warmup=1, iters=3, tiny=True)
 
+    # Each config is fault-isolated: an OOM or compile failure in one
+    # (e.g. bert-large at this batch on a smaller-HBM part) records an
+    # error entry instead of costing the whole round's benchmark artifact.
     configs = {}
-    for lvl in ("O2", "O3"):
-        configs[f"resnet50_{lvl.lower()}"] = bench_resnet(lvl, peak=peak,
-                                                          **rn_args)
-    configs["gpt_small_o2"] = bench_gpt(peak=peak, **gpt_args)
 
-    best_lvl, best = max(
-        ((k, v) for k, v in configs.items() if k.startswith("resnet50")),
-        key=lambda kv: kv[1]["img_s"])
+    def record(name, fn, **kw):
+        # one in-place retry first: the tunneled device occasionally drops
+        # an attempt that succeeds immediately on rerun; only a SECOND
+        # failure (e.g. a genuine OOM) is recorded as this config's error
+        for attempt in (0, 1):
+            try:
+                configs[name] = fn(peak=peak, **kw)
+                return
+            except Exception as e:  # noqa: BLE001 - diagnostic record
+                err = f"{type(e).__name__}: {e}"[:300]
+                if attempt == 0:
+                    time.sleep(10)
+        configs[name] = {"error": err}
+
+    record("resnet50_o2", bench_resnet, opt_level="O2", **rn_args)
+    record("resnet50_o3", bench_resnet, opt_level="O3", **rn_args)
+    record("gpt_small_o2", bench_gpt, **gpt_args)
+    record("bert_large_lamb_o2", bench_bert, **bert_args)
+
+    ok_rn = [(k, v) for k, v in configs.items()
+             if k.startswith("resnet50") and "img_s" in v]
+    if not ok_rn:
+        raise RuntimeError(f"no ResNet-50 config succeeded: {configs}")
+    best_lvl, best = max(ok_rn, key=lambda kv: kv[1]["img_s"])
     print(json.dumps({
         "metric": f"resnet50_amp_{best_lvl.split('_')[1]}_fused_adam_"
                   f"throughput_{platform}_b{best['batch']}_{best['px']}px",

@@ -60,13 +60,30 @@ def main():
                 "time_s": float(case.get("time", 0.0)),
             })
 
-    import jax  # after the subprocess: record what the chip looks like
-    dev = jax.devices()[0]
+    # after the subprocess: record what the chip looks like (guarded — a
+    # wedged device lease blocks PJRT init forever with no error; reuse
+    # bench.py's watchdog)
+    sys.path.insert(0, str(REPO))
+    info = {"platform": "unknown", "device_kind": "unknown", "jax": "?"}
+    try:
+        import jax
+
+        from bench import probe_devices
+        devices = probe_devices(120)
+        if devices is not None:
+            info.update(platform=devices[0].platform,
+                        device_kind=getattr(devices[0], "device_kind", "?"),
+                        jax=jax.__version__)
+        else:
+            info["platform"] = "unknown (backend init blocked >120s)"
+    except Exception as e:  # noqa: BLE001 - record, don't lose the log
+        info["platform"] = f"unknown (init error: {type(e).__name__}: {e})"
+
     out = {
         "artifact": "on-chip test run log (VERDICT r1 item 4/5)",
-        "platform": dev.platform,
-        "device_kind": getattr(dev, "device_kind", "?"),
-        "jax": jax.__version__,
+        "platform": info["platform"],
+        "device_kind": info["device_kind"],
+        "jax": info["jax"],
         "env": {"APEX_TPU_TEST_PLATFORM": "axon"},
         "cmd": "python tools/onchip_run.py " + str(rnd),
         "selection": SELECTION,
