@@ -1,0 +1,51 @@
+"""Units for bench.py's harness pieces (the benchmark itself runs on the
+driver's chip): the PJRT-init watchdog and the FLOP-count fallback."""
+
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO))
+
+import bench  # noqa: E402
+
+
+def test_probe_devices_returns_devices():
+    devices = bench.probe_devices(60)
+    assert devices, "CPU backend must enumerate"
+
+
+def test_probe_devices_times_out_on_hang(monkeypatch):
+    monkeypatch.setattr(bench.jax, "devices",
+                        lambda *a: time.sleep(30))
+    t0 = time.time()
+    assert bench.probe_devices(1.0) is None
+    assert time.time() - t0 < 5
+
+
+def test_probe_devices_reraises_init_errors(monkeypatch):
+    def boom():
+        raise RuntimeError("plugin exploded")
+    monkeypatch.setattr(bench.jax, "devices", boom)
+    with pytest.raises(RuntimeError, match="plugin exploded"):
+        bench.probe_devices(30)
+
+
+def test_step_flops_fallback():
+    class NoCost:
+        def cost_analysis(self):
+            raise NotImplementedError
+    assert bench.step_flops(NoCost(), fallback=123.0) == 123.0
+
+    class ListCost:
+        def cost_analysis(self):
+            return [{"flops": 7.0}]
+    assert bench.step_flops(ListCost(), fallback=0.0) == 7.0
+
+    class ZeroCost:  # some backends report 0 — fall back
+        def cost_analysis(self):
+            return {"flops": 0.0}
+    assert bench.step_flops(ZeroCost(), fallback=5.0) == 5.0

@@ -67,6 +67,7 @@ def main():
     info = {"platform": "unknown", "device_kind": "unknown", "jax": "?"}
     try:
         import jax
+        info["jax"] = jax.__version__  # known even if the probe blocks
 
         from bench import probe_devices
         devices = probe_devices(120)
