@@ -126,12 +126,14 @@ def test_packed_lamb_at_bert_base_scale():
     gs = [jnp.full(p.shape, 1e-4, jnp.float32) for p in ps]
     zs = [jnp.zeros(p.shape, jnp.float32) for p in ps]
 
+    bc = jnp.ones((len(ps),), jnp.float32)  # per-tensor (n_tensors,) tables
+
     @jax.jit
     def upd(gs, ps, ms, vs):
         deltas, nm, nv = _pallas_lamb_update(
             gs, ps, ms, vs, lr=jnp.float32(1e-3), beta1=0.9, beta2=0.999,
             eps=1e-6, weight_decay=0.01, clip=jnp.float32(1.0),
-            bc1=jnp.float32(1.0), bc2=jnp.float32(1.0))
+            bc1=bc, bc2=bc)
         return sum(jnp.sum(d.astype(jnp.float32)) for d in deltas)
 
     out = float(upd(gs, ps, zs, zs))
