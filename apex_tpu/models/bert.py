@@ -201,11 +201,15 @@ def pretraining_loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels,
                      mlm_mask):
     """Masked-LM + NSP cross entropy in fp32; ``mlm_mask`` selects the
     masked positions (1.0 where a prediction is scored)."""
-    logp = amp_ops.log_softmax(mlm_logits, axis=-1)
-    mlm_ll = jnp.take_along_axis(logp, mlm_labels[..., None],
-                                 axis=-1).squeeze(-1)
+    # -logp[label] = logsumexp - logits[label]: identical math to
+    # log_softmax + gather without materializing the (B, L, V) fp32
+    # log-probability tensor (see models/gpt.py lm_loss) — the fp32
+    # policy rides amp_ops.logsumexp, the gather reads the raw logits.
+    lse = amp_ops.logsumexp(mlm_logits, axis=-1)
+    picked = jnp.take_along_axis(mlm_logits, mlm_labels[..., None],
+                                 axis=-1).squeeze(-1).astype(lse.dtype)
     denom = jnp.maximum(mlm_mask.sum(), 1.0)
-    mlm_loss = -(mlm_ll * mlm_mask).sum() / denom
+    mlm_loss = ((lse - picked) * mlm_mask).sum() / denom
     nsp_logp = amp_ops.log_softmax(nsp_logits, axis=-1)
     nsp_loss = -jnp.mean(
         jnp.take_along_axis(nsp_logp, nsp_labels[:, None], axis=-1))

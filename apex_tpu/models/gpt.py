@@ -196,13 +196,20 @@ def lm_loss(logits: jax.Array, targets: jax.Array,
     instead would silently scale gradients by the shard count.  Report
     the global loss as ``lax.psum(loss, axis)`` (not pmean).
     """
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    # -logp[target] = logsumexp(logits) - logits[target]: same math as
+    # log_softmax + gather, but the (B, L, V) fp32 log-probability tensor
+    # is never materialized in HBM — the cast fuses into the reduction
+    # and only the (B, L) lse/picked rows are written (the gather reads
+    # the bf16 logits directly).  At (8, 2047, 32000) that saves a ~2 GB
+    # fp32 round-trip per step.
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logits, targets[..., None],
+                                 axis=-1)[..., 0].astype(jnp.float32)
     if mask is None:
         m = jnp.ones(picked.shape, jnp.float32)
     else:
         m = mask.astype(jnp.float32)
-    total = -jnp.sum(picked * m)
+    total = jnp.sum((lse - picked) * m)
     count = jnp.sum(m)
     if seq_axis_name is not None:
         count = jax.lax.psum(count, seq_axis_name)

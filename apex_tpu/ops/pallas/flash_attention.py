@@ -57,8 +57,24 @@ def _causal_mask(bq, bk, q_start, k_start):
     return qpos >= kpos
 
 
+def _causal_dispatch(causal, live, straddle, update, dead=None):
+    """Shared block-dispatch stanza of the four kernels: fully-visible
+    live blocks skip the iota/compare/where mask work, only
+    diagonal-straddling blocks pay it (~60% of live blocks skip at
+    L=2048 with 512 blocks).  ``dead`` optionally runs on non-live
+    blocks (the fused backward zeroes its dq partial plane there)."""
+    if causal:
+        pl.when(jnp.logical_and(live, straddle))(lambda: update(True))
+        pl.when(jnp.logical_and(live, jnp.logical_not(straddle)))(
+            lambda: update(False))
+        if dead is not None:
+            pl.when(jnp.logical_not(live))(dead)
+    else:
+        update(False)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal,
+                m_scr, l_scr, acc_scr, *, causal, has_bias,
                 block_q, block_k, nk):
     ik = pl.program_id(2)
     iq = pl.program_id(1)
@@ -73,20 +89,27 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
     k_start = ik * block_k
     # Whole block strictly above the diagonal contributes nothing.
     live = (not causal) or (k_start <= q_start + block_q - 1)
+    # Only diagonal-straddling blocks need the iota/compare/where mask
+    # work; fully-below-diagonal blocks are entirely visible.  (~60% of
+    # live blocks skip the mask at L=2048 with 512-blocks.)
+    straddle = k_start + block_k - 1 > q_start
 
-    @pl.when(live)
-    def _update():
-        # fp32 operands measure faster here than bf16 (Mosaic relayout
-        # costs outweigh the MXU rate difference at d=64) and match the
-        # fp32-softmax policy exactly.
-        q = q_ref[0].astype(jnp.float32)          # (bq, d)
-        k = k_ref[0].astype(jnp.float32)          # (bk, d)
+    def _update(masked):
+        # Matmul operands keep their storage dtype: bf16 inputs ride the
+        # MXU at full rate, fp32 inputs keep exact fp32 semantics.
+        # Accumulation is always fp32 (preferred_element_type), and every
+        # softmax/statistics op stays fp32 — the amp fp32-softmax policy
+        # is about the *reduction* precision, not MXU operand storage.
+        # The softmax scale is folded into q by the caller (one (L, d)
+        # pass instead of an (L, L) one here).
+        q = q_ref[0]                              # (bq, d)
+        k = k_ref[0]                              # (bk, d)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale     # (bq, bk)
-        s = s + bias_ref[0]                       # (1, bk) broadcast
-        mask = None
-        if causal:
+            preferred_element_type=jnp.float32)             # (bq, bk)
+        if has_bias:
+            s = s + bias_ref[0]                   # (1, bk) broadcast
+        if masked:
             mask = _causal_mask(block_q, block_k, q_start, k_start)
             s = jnp.where(mask, s, NEG_INF)
 
@@ -95,17 +118,28 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
         corr = jnp.exp(m_prev - m_new)             # (bq, LANES)
         p = jnp.exp(s - m_new[:, :1])              # (bq, bk)
-        if mask is not None:
-            p = jnp.where(mask, p, 0.0)
-        p = jnp.where(bias_ref[0] > NEG_INF / 2, p, 0.0)
+        # Masked entries need no explicit zeroing here: s == NEG_INF and
+        # a finite m_new make exp underflow to exactly 0 (causal rows
+        # always see the diagonal, so m_new is finite in every live
+        # block).  Only the bias path can produce fully-masked rows
+        # (m_new == NEG_INF -> exp(0) == 1), so only it re-zeroes.
+        if has_bias:
+            p = jnp.where(bias_ref[0] > NEG_INF / 2, p, 0.0)
+            if masked:
+                p = jnp.where(mask, p, 0.0)
         l_new = l_scr[...] * corr + jnp.broadcast_to(
             p.sum(axis=1, keepdims=True), m_prev.shape)
+        # p rides the MXU in the storage dtype (the flash convention: the
+        # probabilities are cast to the value dtype for the PV matmul;
+        # the fp32 accumulator keeps the reduction exact).
         pv = jax.lax.dot_general(
-            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)    # (bq, d)
         acc_scr[...] = acc_scr[...] * corr[:, :1] + pv
         m_scr[...] = m_new
         l_scr[...] = l_new
+
+    _causal_dispatch(causal, live, straddle, _update)
 
     @pl.when(ik == nk - 1)
     def _emit():
@@ -116,26 +150,37 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
                                m_scr[:, :_STATS_W] + jnp.log(safe_l))
 
 
-def _bwd_p(q, k, bias_row, lse_col, *, scale, causal, q_start, k_start,
+def _bwd_p(q, k, bias_row, lse_col, *, masked, has_bias, q_start, k_start,
            block_q, block_k):
     """Recompute the probability block from the saved logsumexp.
-    ``bias_row``: (1, bk); ``lse_col``: (bq, 1)."""
+    ``q`` is pre-scaled by the caller; ``bias_row``: (1, bk);
+    ``lse_col``: (bq, 1).  ``masked`` says this block straddles the
+    causal diagonal (fully-visible blocks skip the mask work).  Without
+    a bias, masked entries and NEG_INF rows cannot make exp misfire
+    (s - lse underflows to 0 for s == NEG_INF, and lse is finite for
+    every causal row), so the explicit zeroing wheres exist only on the
+    bias path."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale
-    s = s + bias_row
+        preferred_element_type=jnp.float32)
+    if has_bias:
+        s = s + bias_row
+    if masked and not has_bias:
+        s = jnp.where(_causal_mask(block_q, block_k, q_start, k_start),
+                      s, NEG_INF)
     p = jnp.exp(s - lse_col)
-    if causal:
-        p = jnp.where(_causal_mask(block_q, block_k, q_start, k_start),
-                      p, 0.0)
-    p = jnp.where(bias_row > NEG_INF / 2, p, 0.0)
-    # lse == NEG_INF marks fully-masked rows: their p must be 0.
-    p = jnp.where(lse_col > NEG_INF / 2, p, 0.0)
+    if has_bias:
+        if masked:
+            p = jnp.where(_causal_mask(block_q, block_k, q_start, k_start),
+                          p, 0.0)
+        p = jnp.where(bias_row > NEG_INF / 2, p, 0.0)
+        # lse == NEG_INF marks fully-masked rows: their p must be 0.
+        p = jnp.where(lse_col > NEG_INF / 2, p, 0.0)
     return p
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
-               dq_ref, dq_scr, *, scale, causal, block_q, block_k, nk):
+               dq_ref, dq_scr, *, causal, has_bias, block_q, block_k, nk):
     ik = pl.program_id(2)
     iq = pl.program_id(1)
 
@@ -146,22 +191,28 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
     q_start = iq * block_q
     k_start = ik * block_k
     live = (not causal) or (k_start <= q_start + block_q - 1)
+    straddle = k_start + block_k - 1 > q_start
 
-    @pl.when(live)
-    def _update():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        p = _bwd_p(q, k, bias_ref[0], lse_ref[0][:, :1], scale=scale,
-                   causal=causal, q_start=q_start, k_start=k_start,
+    def _update(masked):
+        q = q_ref[0]
+        k = k_ref[0]
+        p = _bwd_p(q, k, bias_ref[0], lse_ref[0][:, :1], masked=masked,
+                   has_bias=has_bias, q_start=q_start, k_start=k_start,
                    block_q=block_q, block_k=block_k)
-        do = do_ref[0].astype(jnp.float32)
+        do = do_ref[0]
         dp = jax.lax.dot_general(
-            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)          # (bq, bk)
-        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        # The softmax scale lives in the pre-scaled q (and is applied to
+        # dq once, outside the kernel) — no (bq, bk) scale pass here.
+        ds = p * (dp - delta_ref[0][:, :1])
+        # ds is cast to the storage dtype for its MXU op (flash bwd
+        # convention); the fp32 scratch accumulator carries the sum.
         dq_scr[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    _causal_dispatch(causal, live, straddle, _update)
 
     @pl.when(ik == nk - 1)
     def _emit():
@@ -169,7 +220,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, causal, has_bias,
                 block_q, block_k, nq):
     iq = pl.program_id(2)
     ik = pl.program_id(1)
@@ -182,30 +233,146 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
     q_start = iq * block_q
     k_start = ik * block_k
     live = (not causal) or (k_start <= q_start + block_q - 1)
+    straddle = k_start + block_k - 1 > q_start
 
-    @pl.when(live)
-    def _update():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        p = _bwd_p(q, k, bias_ref[0], lse_ref[0][:, :1], scale=scale,
-                   causal=causal, q_start=q_start, k_start=k_start,
+    def _update(masked):
+        q = q_ref[0]
+        k = k_ref[0]
+        p = _bwd_p(q, k, bias_ref[0], lse_ref[0][:, :1], masked=masked,
+                   has_bias=has_bias, q_start=q_start, k_start=k_start,
                    block_q=block_q, block_k=block_k)
-        do = do_ref[0].astype(jnp.float32)
+        do = do_ref[0]
         dv_scr[...] += jax.lax.dot_general(
-            p.astype(jnp.float32), do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # (bk, d)
         dp = jax.lax.dot_general(
-            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, :1]) * scale      # (bq, bk)
+        # dk = ds^T @ q_scaled is exact: d(s)/d(k) carries the scale via
+        # the pre-scaled q, so no (bq, bk) scale pass is needed.
+        ds = p * (dp - delta_ref[0][:, :1])              # (bq, bk)
         dk_scr[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    _causal_dispatch(causal, live, straddle, _update)
 
     @pl.when(iq == nq - 1)
     def _emit():
         dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      bias_ref, dqp_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                      *, causal, has_bias, block_q, block_k, nq):
+    """One-pass backward: p/dp are computed once per block pair and feed
+    dq, dk and dv together (the two-pass kernels recompute them, costing
+    an extra score matmul + exp per pair).  Grid (bh, ik, iq): dk/dv
+    accumulate in VMEM scratch over the inner q walk; dq can't (it's
+    indexed by iq), so each k block writes its dq contribution to its
+    own fp32 partial plane, summed by XLA outside — O(nk) extra HBM, so
+    the caller only picks this kernel when nk is small."""
+    iq = pl.program_id(2)
+    ik = pl.program_id(1)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+    live = (not causal) or (k_start <= q_start + block_q - 1)
+    straddle = k_start + block_k - 1 > q_start
+
+    def _update(masked):
+        q = q_ref[0]
+        k = k_ref[0]
+        p = _bwd_p(q, k, bias_ref[0], lse_ref[0][:, :1], masked=masked,
+                   has_bias=has_bias, q_start=q_start, k_start=k_start,
+                   block_q=block_q, block_k=block_k)
+        do = do_ref[0]
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1])              # (bq, bk)
+        ds_c = ds.astype(q.dtype)
+        dk_scr[...] += jax.lax.dot_general(
+            ds_c, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dqp_ref[0, 0] = jax.lax.dot_general(
+            ds_c, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bq, d) fp32
+
+    def _zero_dead():
+        # Dead blocks still own their dq partial plane slot: zero it.
+        dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
+
+    _causal_dispatch(causal, live, straddle, _update, dead=_zero_dead)
+
+    @pl.when(iq == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "has_bias", "block_q",
+                                    "block_k", "num_heads"))
+def _flash_bwd_fused(qf, kf, vf, of, do_f, lse, bias, dlse_f, *, causal,
+                     has_bias, block_q, block_k, num_heads):
+    bh, lp, d = qf.shape
+    nq, nk = lp // block_q, lp // block_k
+    h = num_heads
+    delta = jnp.sum(of.astype(jnp.float32) * do_f.astype(jnp.float32),
+                    axis=-1, keepdims=True)                    # (bh, lp, 1)
+    delta = delta - dlse_f[..., None]      # lse cotangent folds into delta
+    delta = jnp.broadcast_to(delta, (bh, lp, _STATS_W))
+
+    dq_part, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, causal=causal,
+                          has_bias=has_bias, block_q=block_q,
+                          block_k=block_k, nq=nq),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh_, ik, iq: (bh_, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ik, iq: (bh_, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ik, iq: (bh_, ik, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh_, ik, iq: (bh_, iq, 0)),
+            pl.BlockSpec((1, block_q, _STATS_W),
+                         lambda bh_, ik, iq: (bh_, iq, 0)),
+            pl.BlockSpec((1, block_q, _STATS_W),
+                         lambda bh_, ik, iq: (bh_, iq, 0)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda bh_, ik, iq: (bh_ // h, 0, ik)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bh_, ik, iq: (ik, bh_, iq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ik, iq: (bh_, ik, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, ik, iq: (bh_, ik, 0)),
+        ],
+        out_shape=[
+            _sds((nk, bh, lp, d), jnp.float32, qf),
+            _sds((bh, lp, d), qf.dtype, qf),
+            _sds((bh, lp, d), qf.dtype, qf),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=not on_tpu(),
+    )(qf, kf, vf, do_f, lse, delta, bias)
+    dq = dq_part.sum(axis=0).astype(qf.dtype)
+    return dq, dk, dv
+
+
+#: fused backward needs an (nk, BH, L, d) fp32 dq-partials buffer; above
+#: this many k blocks the extra HBM outweighs the saved recompute and the
+#: two-pass kernels take over (long-context / ring shards).
+_FUSED_BWD_MAX_NK = 8
 
 
 def _pad_bhld(t, lp):
@@ -237,9 +404,9 @@ def _unprep(t, b, l, h, d):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("scale", "causal", "block_q", "block_k",
-                                    "num_heads"))
-def _flash_fwd(qf, kf, vf, bias, *, scale, causal, block_q, block_k,
+                   static_argnames=("causal", "has_bias", "block_q",
+                                    "block_k", "num_heads"))
+def _flash_fwd(qf, kf, vf, bias, *, causal, has_bias, block_q, block_k,
                num_heads):
     bh, lp, d = qf.shape
     nq, nk = lp // block_q, lp // block_k
@@ -247,7 +414,7 @@ def _flash_fwd(qf, kf, vf, bias, *, scale, causal, block_q, block_k,
     h = num_heads
 
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+        functools.partial(_fwd_kernel, causal=causal, has_bias=has_bias,
                           block_q=block_q, block_k=block_k, nk=nk),
         grid=grid,
         in_specs=[
@@ -279,10 +446,10 @@ def _flash_fwd(qf, kf, vf, bias, *, scale, causal, block_q, block_k,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("scale", "causal", "block_q", "block_k",
-                                    "num_heads"))
-def _flash_bwd(qf, kf, vf, of, do_f, lse, bias, dlse_f, *, scale, causal,
-               block_q, block_k, num_heads):
+                   static_argnames=("causal", "has_bias", "block_q",
+                                    "block_k", "num_heads"))
+def _flash_bwd(qf, kf, vf, of, do_f, lse, bias, dlse_f, *, causal,
+               has_bias, block_q, block_k, num_heads):
     bh, lp, d = qf.shape
     nq, nk = lp // block_q, lp // block_k
     h = num_heads
@@ -294,7 +461,7 @@ def _flash_bwd(qf, kf, vf, of, do_f, lse, bias, dlse_f, *, scale, causal,
     common_in = [qf, kf, vf, do_f, lse, delta, bias]
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal,
+        functools.partial(_dq_kernel, causal=causal, has_bias=has_bias,
                           block_q=block_q, block_k=block_k, nk=nk),
         grid=(bh, nq, nk),
         in_specs=[
@@ -317,7 +484,7 @@ def _flash_bwd(qf, kf, vf, of, do_f, lse, bias, dlse_f, *, scale, causal,
     )(*common_in)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+        functools.partial(_dkv_kernel, causal=causal, has_bias=has_bias,
                           block_q=block_q, block_k=block_k, nq=nq),
         grid=(bh, nk, nq),
         in_specs=[
@@ -347,10 +514,10 @@ def _flash_bwd(qf, kf, vf, of, do_f, lse, bias, dlse_f, *, scale, causal,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, bias, scale, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, bias, scale, causal, block_q, block_k, has_bias):
     (out, lse_pub), _ = _flash_core(q, k, v, bias, scale, causal,
-                                    block_q, block_k)
+                                    block_q, block_k, has_bias)
     return out, lse_pub
 
 
@@ -359,21 +526,28 @@ def _lse_public(lse, b, l, h):
     return jnp.moveaxis(lse[:, :, 0].reshape(b, h, -1)[:, :, :l], 1, 2)
 
 
-def _flash_core(q, k, v, bias, scale, causal, block_q, block_k):
+def _flash_core(q, k, v, bias, scale, causal, block_q, block_k, has_bias):
     b, l, h, d = q.shape
     qf, kf, vf, bias_p, lp = _prep(q, k, v, bias, block_q, block_k)
-    of, lse = _flash_fwd(qf, kf, vf, bias_p, scale=scale, causal=causal,
-                         block_q=block_q, block_k=block_k, num_heads=h)
+    # Softmax scale folded into q once ((L, d) elementwise, fused into
+    # the prep reshuffle) instead of an (L, L) pass per score block.
+    qf = qf * jnp.asarray(scale, qf.dtype)
+    of, lse = _flash_fwd(qf, kf, vf, bias_p, causal=causal,
+                         has_bias=has_bias, block_q=block_q,
+                         block_k=block_k, num_heads=h)
     return ((_unprep(of, b, l, h, d), _lse_public(lse, b, l, h)),
             (qf, kf, vf, of, lse, bias_p))
 
 
-def _flash_fwd_rule(q, k, v, bias, scale, causal, block_q, block_k):
-    outs, res = _flash_core(q, k, v, bias, scale, causal, block_q, block_k)
+def _flash_fwd_rule(q, k, v, bias, scale, causal, block_q, block_k,
+                    has_bias):
+    outs, res = _flash_core(q, k, v, bias, scale, causal, block_q,
+                            block_k, has_bias)
     return outs, (res, q.shape)
 
 
-def _flash_bwd_rule(scale, causal, block_q, block_k, saved, cotangents):
+def _flash_bwd_rule(scale, causal, block_q, block_k, has_bias, saved,
+                    cotangents):
     dout, dlse = cotangents
     (qf, kf, vf, of, lse, bias_p), (b, l, h, d) = saved
     lp = qf.shape[1]
@@ -384,10 +558,14 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, saved, cotangents):
     dlse_f = jnp.moveaxis(dlse.astype(jnp.float32), 1, 2).reshape(b * h, l)
     if lp != l:
         dlse_f = jnp.pad(dlse_f, ((0, 0), (0, lp - l)))
-    dqf, dkf, dvf = _flash_bwd(qf, kf, vf, of, do_f, lse, bias_p, dlse_f,
-                               scale=scale, causal=causal, block_q=block_q,
-                               block_k=block_k, num_heads=h)
-    dq = _unprep(dqf, b, l, h, d)
+    bwd = (_flash_bwd_fused if lp // block_k <= _FUSED_BWD_MAX_NK
+           else _flash_bwd)
+    dqf, dkf, dvf = bwd(qf, kf, vf, of, do_f, lse, bias_p, dlse_f,
+                        causal=causal, has_bias=has_bias,
+                        block_q=block_q, block_k=block_k, num_heads=h)
+    # The kernels differentiate w.r.t. the pre-scaled q: dk comes out
+    # exact (ds^T @ q_scaled), dq needs the one deferred scale.
+    dq = _unprep(dqf, b, l, h, d) * jnp.asarray(scale, dqf.dtype)
     dk = _unprep(dkf, b, l, h, d)
     dv = _unprep(dvf, b, l, h, d)
     return dq, dk, dv, jnp.zeros((b, l), jnp.float32)
@@ -426,12 +604,14 @@ def _jnp_attention(q, k, v, *, causal, kv_mask, scale, return_lse=False):
 
 def _default_block(l: int) -> int:
     """Default q/k block edge by sequence length: 512, growing to 1024 at
-    L >= 4096 where fewer, larger grid steps measure ~20% faster on-chip
-    (per-step overhead amortizes; 2048 exceeds VMEM with the fp32 score
-    block) — but only when the larger block adds no padding: for L not
-    near a multiple of 1024 the padded sequence would grow, and the
-    quadratic extra attention work erases the per-step win."""
-    if l >= 4096 and _ceil_to(l, 1024) == _ceil_to(l, 512):
+    L >= 2048 where fewer, larger grid steps measure ~18% faster on-chip
+    (per-step overhead and the online-softmax stats updates amortize;
+    B8·H12·L2048·d64 fwd 3.2 -> 2.6 ms, fwd+bwd 9.1 -> 7.5 ms; 2048
+    blocks fail to compile with the fp32 score tile) — but only when the
+    larger block adds no padding: for L not near a multiple of 1024 the
+    padded sequence would grow, and the quadratic extra attention work
+    erases the per-step win."""
+    if l >= 2048 and _ceil_to(l, 1024) == _ceil_to(l, 512):
         return 1024
     return 512
 
@@ -451,7 +631,7 @@ def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
     (scores never materialized; fp32 softmax; masked rows emit zeros).
     ``kv_mask``: optional ``(B, Lk)`` bool key mask (True = attend).
     ``block_q``/``block_k`` default by sequence length — 512, growing to
-    1024 at L >= 4096 where fewer, larger grid steps measure ~20% faster
+    1024 at L >= 2048 where fewer, larger grid steps measure ~18% faster
     on-chip (per-step overhead amortizes; 2048 blocks exceed VMEM with
     the fp32 score block) — and are clamped to the (padded) length.
     Cross-attention (``Lq != Lk``) routes to an equivalent jnp path — the
@@ -486,7 +666,18 @@ def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
     if kv_mask is not None:
         bias = jnp.where(kv_mask, 0.0, NEG_INF).astype(jnp.float32)
     else:
+        # Placeholder keeping the kernel input list static; with
+        # has_bias=False the kernels never read it (no bias add, no
+        # zeroing wheres).
         bias = jnp.zeros((b, l), jnp.float32)
+    # _prep pads keys with a NEG_INF bias column; that only reaches the
+    # kernels on the bias path, so non-causal padded lengths must take
+    # it even without a user mask (else zero-padded keys attend and
+    # inflate the normalizer).  Causal is safe bias-free: every padded
+    # key sits at kpos >= l > qpos for every real row.
+    import math
+    padded = l % math.lcm(int(block_q), int(block_k)) != 0
+    has_bias = kv_mask is not None or (padded and not causal)
     out, lse = _flash(q, k, v, bias, float(scale), bool(causal),
-                      int(block_q), int(block_k))
+                      int(block_q), int(block_k), has_bias)
     return (out, lse) if return_lse else out

@@ -101,6 +101,42 @@ def test_odd_length_padding_and_bf16():
                                rtol=2e-2, atol=2e-2)
 
 
+def test_noncausal_padded_keys_do_not_attend():
+    """Regression: with no kv_mask and a non-causal odd length, the
+    zero-padded key columns must not enter the softmax (they ride the
+    NEG_INF padding bias _prep builds — the fast no-bias kernel path is
+    only legal when nothing is padded or causality hides the pad)."""
+    q, k, v = _qkv(l=300, seed=3)
+    out = flash_attention(q, k, v, causal=False, block_q=128, block_k=128)
+    ref = ref_attn(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_two_pass_backward_matches_reference(monkeypatch):
+    """The long-context two-pass backward (dq + dkv kernels) is the
+    fallback above _FUSED_BWD_MAX_NK k-blocks; force it here so both
+    backward implementations keep gradient coverage."""
+    from apex_tpu.ops.pallas import flash_attention as fa
+    monkeypatch.setattr(fa, "_FUSED_BWD_MAX_NK", 0)
+    q, k, v = _qkv()
+    rng = np.random.RandomState(1)
+    mask = jnp.asarray(rng.rand(B, L) > 0.2).at[:, 0].set(True)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(
+            jnp.sin(fn(q, k, v)).astype(jnp.float32))
+
+    gf = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, kv_mask=mask, block_q=128, block_k=128)),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(lambda q, k, v: ref_attn(
+        q, k, v, causal=True, kv_mask=mask)), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=GTOL, atol=GTOL)
+
+
 def test_fully_masked_rows_emit_zeros():
     q, k, v = _qkv(l=256)
     mask = jnp.zeros((B, 256), bool).at[0].set(True)   # batch 1 all-masked
@@ -128,12 +164,12 @@ def test_dispatcher_uses_flash():
 
 
 def test_default_blocks_scale_with_length():
-    """The block-size default switches to 1024 at L >= 4096 (per-step
+    """The block-size default switches to 1024 at L >= 2048 (per-step
     overhead amortization measured on chip); the selection logic is
     checked here, the numerics hardware-side below."""
     from apex_tpu.ops.pallas.flash_attention import _default_block
     cases = (
-        (512, 512), (4095, 512), (4096, 1024), (16384, 1024),
+        (512, 512), (2047, 512), (2048, 1024), (4096, 1024), (16384, 1024),
         # 1024 blocks would pad 4608 -> 5120 (~23% extra quadratic work)
         # while 512 pads nothing: stay at 512.
         (4608, 512),
