@@ -48,6 +48,17 @@ def gpt_small() -> GPTConfig:
     return GPTConfig()
 
 
+def gpt_small_tpu() -> GPTConfig:
+    """gpt-small with TPU-native head geometry: 6 heads of 128 instead
+    of 12 of 64.  head_dim 128 fills the MXU/VPU lane width, measured
+    35-40% faster flash attention at identical FLOPs and parameter
+    count (B8·L2048 on v5e: fwd 2.50 -> 1.63 ms/layer, fwd+bwd 6.51 ->
+    3.89 ms/layer).  Prefer this shape for models trained from scratch
+    on TPU; :func:`gpt_small` keeps the GPU-conventional 12x64 for
+    checkpoint parity."""
+    return GPTConfig(num_heads=6)
+
+
 def gpt_tiny() -> GPTConfig:
     """Test-scale config."""
     return GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,

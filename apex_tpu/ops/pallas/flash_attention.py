@@ -28,6 +28,7 @@ from block offsets inside the kernel.  Fully-masked query rows produce
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -320,6 +321,20 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
+def _delta(of, do_f, dlse_f):
+    """Per-row backward offset ``sum(o * do) - dlse`` in fp32, broadcast
+    to the ``_STATS_W`` stats width: a cotangent on the logsumexp folds
+    into the backward as ``ds_ij = p_ij (dp_ij - delta_i + dlse_i)``
+    (since dlse_i/ds_ij = p_ij); zero-cotangent callers pay nothing.
+    Shared by both backward implementations so the fold stays in one
+    place."""
+    bh, lp = of.shape[0], of.shape[1]
+    delta = jnp.sum(of.astype(jnp.float32) * do_f.astype(jnp.float32),
+                    axis=-1, keepdims=True)                    # (bh, lp, 1)
+    delta = delta - dlse_f[..., None]
+    return jnp.broadcast_to(delta, (bh, lp, _STATS_W))
+
+
 @functools.partial(jax.jit,
                    static_argnames=("causal", "has_bias", "block_q",
                                     "block_k", "num_heads"))
@@ -328,10 +343,7 @@ def _flash_bwd_fused(qf, kf, vf, of, do_f, lse, bias, dlse_f, *, causal,
     bh, lp, d = qf.shape
     nq, nk = lp // block_q, lp // block_k
     h = num_heads
-    delta = jnp.sum(of.astype(jnp.float32) * do_f.astype(jnp.float32),
-                    axis=-1, keepdims=True)                    # (bh, lp, 1)
-    delta = delta - dlse_f[..., None]      # lse cotangent folds into delta
-    delta = jnp.broadcast_to(delta, (bh, lp, _STATS_W))
+    delta = _delta(of, do_f, dlse_f)
 
     dq_part, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, causal=causal,
@@ -387,8 +399,6 @@ def _pad_bhld(t, lp):
 def _prep(q, k, v, bias, block_q, block_k):
     """(B, L, H, D) → padded (BH, Lp, D); pad the additive key bias with
     ``NEG_INF`` so padded keys never attend."""
-    import math
-
     l = q.shape[1]
     lp = _ceil_to(l, math.lcm(block_q, block_k))
     if bias is not None:
@@ -453,10 +463,7 @@ def _flash_bwd(qf, kf, vf, of, do_f, lse, bias, dlse_f, *, causal,
     bh, lp, d = qf.shape
     nq, nk = lp // block_q, lp // block_k
     h = num_heads
-    delta = jnp.sum(of.astype(jnp.float32) * do_f.astype(jnp.float32),
-                    axis=-1, keepdims=True)                    # (bh, lp, 1)
-    delta = delta - dlse_f[..., None]      # lse cotangent folds into delta
-    delta = jnp.broadcast_to(delta, (bh, lp, _STATS_W))
+    delta = _delta(of, do_f, dlse_f)
 
     common_in = [qf, kf, vf, do_f, lse, delta, bias]
 
@@ -675,7 +682,6 @@ def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
     # it even without a user mask (else zero-padded keys attend and
     # inflate the normalizer).  Causal is safe bias-free: every padded
     # key sits at kpos >= l > qpos for every real row.
-    import math
     padded = l % math.lcm(int(block_q), int(block_k)) != 0
     has_bias = kv_mask is not None or (padded and not causal)
     out, lse = _flash(q, k, v, bias, float(scale), bool(causal),

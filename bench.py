@@ -115,14 +115,18 @@ def bench_resnet(opt_level: str, batch: int, size: int, warmup: int,
 
 
 def bench_gpt(batch: int, seq: int, warmup: int, iters: int, peak: float,
-              tiny: bool):
+              tiny: bool, tpu_heads: bool = False):
     import dataclasses
 
     from apex_tpu import amp
-    from apex_tpu.models.gpt import GPTModel, gpt_small, gpt_tiny, lm_loss
+    from apex_tpu.models.gpt import (
+        GPTModel, gpt_small, gpt_small_tpu, gpt_tiny, lm_loss)
     from apex_tpu.optimizers import FusedAdam
 
-    cfg = gpt_tiny() if tiny else gpt_small()
+    # tpu_heads: same params/FLOPs with the TPU-native 6x128 head
+    # geometry (full MXU lane width in the flash kernels).
+    cfg = gpt_tiny() if tiny else (
+        gpt_small_tpu() if tpu_heads else gpt_small())
     model = GPTModel(cfg)
     ids = jax.random.randint(jax.random.PRNGKey(3), (batch, seq), 0,
                              cfg.vocab_size)
@@ -278,6 +282,11 @@ def main():
     record("resnet50_o2", bench_resnet, opt_level="O2", **rn_args)
     record("resnet50_o3", bench_resnet, opt_level="O3", **rn_args)
     record("gpt_small_o2", bench_gpt, **gpt_args)
+    if on_tpu:
+        # meaningless off-TPU: the tiny CPU stand-in ignores tpu_heads,
+        # so it would just duplicate gpt_small_o2 under another name
+        record("gpt_small_tpu_heads_o2", bench_gpt, tpu_heads=True,
+               **gpt_args)
     record("bert_large_lamb_o2", bench_bert, **bert_args)
 
     ok_rn = [(k, v) for k, v in configs.items()

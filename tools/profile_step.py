@@ -39,7 +39,7 @@ def build(model_name: str, opt_level: str):
     return fn
 
 
-def parse_xplane(logdir: str, top: int = 25):
+def parse_xplane(logdir: str):
     """Aggregate device-plane op durations from the xplane protobuf."""
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
