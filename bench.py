@@ -203,18 +203,19 @@ def _backend_or_die(timeout_s: float = 240.0):
 
 
 def bench_bert(batch: int, seq: int, warmup: int, iters: int, peak: float,
-               tiny: bool):
+               tiny: bool, tpu_heads: bool = False):
     """BASELINE config 4: BERT-large MLM+NSP pretraining step with
     FusedLAMB + FusedLayerNorm + flash attention (amp O2)."""
     import dataclasses
 
     from apex_tpu import amp
     from apex_tpu.models.bert import (
-        BertForPreTraining, bert_large, bert_tiny, pretraining_loss)
+        BertForPreTraining, bert_large, bert_large_tpu, bert_tiny,
+        pretraining_loss)
     from apex_tpu.optimizers import FusedLAMB
 
-    cfg = bert_tiny() if tiny else dataclasses.replace(bert_large(),
-                                                       remat=True)
+    base = bert_large_tpu() if tpu_heads else bert_large()
+    cfg = bert_tiny() if tiny else dataclasses.replace(base, remat=True)
     model = BertForPreTraining(cfg)
     k = jax.random.split(jax.random.PRNGKey(5), 4)
     ids = jax.random.randint(k[0], (batch, seq), 0, cfg.vocab_size)
@@ -288,6 +289,9 @@ def main():
         record("gpt_small_tpu_heads_o2", bench_gpt, tpu_heads=True,
                **gpt_args)
     record("bert_large_lamb_o2", bench_bert, **bert_args)
+    if on_tpu:
+        record("bert_large_tpu_heads_lamb_o2", bench_bert, tpu_heads=True,
+               **bert_args)
 
     ok_rn = [(k, v) for k, v in configs.items()
              if k.startswith("resnet50") and "img_s" in v]
