@@ -640,7 +640,10 @@ def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
     ``block_q``/``block_k`` default by sequence length — 512, growing to
     1024 at L >= 2048 where fewer, larger grid steps measure ~18% faster
     on-chip (per-step overhead amortizes; 2048 blocks exceed VMEM with
-    the fp32 score block) — and are clamped to the (padded) length.
+    the fp32 score block) — and are clamped to the (padded) length,
+    then rounded up to Mosaic tile granularity (``block_q`` to a
+    multiple of 8, ``block_k`` to a multiple of 128 — narrower k blocks
+    miscompile on hardware).
     Cross-attention (``Lq != Lk``) routes to an equivalent jnp path — the
     blockwise kernel packs q and k/v with one shared sequence length.
 
@@ -670,6 +673,13 @@ def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
         block_k = _default_block(l)
     block_q = min(block_q, _ceil_to(l, 128))
     block_k = min(block_k, _ceil_to(l, 128))
+    # Mosaic tile granularity: the score tile is (block_q, block_k), so
+    # block_q rides the 8-sublane dim and block_k the 128-lane dim.
+    # Sub-lane-width k blocks (block_k < 128) compile but produce wrong
+    # numerics on hardware (interpret mode hides it) and would waste the
+    # VPU anyway — round both up to legal sizes.
+    block_q = max(8, _ceil_to(int(block_q), 8))
+    block_k = max(_LANES, _ceil_to(int(block_k), _LANES))
     if kv_mask is not None:
         bias = jnp.where(kv_mask, 0.0, NEG_INF).astype(jnp.float32)
     else:

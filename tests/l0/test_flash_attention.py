@@ -57,6 +57,22 @@ def _qkv(dtype=jnp.float32, l=L, seed=0):
     return mk(), mk(), mk()
 
 
+def _check_grads(q, k, v, causal, mask, **flash_kwargs):
+    """Gradients of a sin-sum loss through the kernel vs the jnp oracle."""
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(
+            jnp.sin(fn(q, k, v)).astype(jnp.float32))
+
+    gf = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, kv_mask=mask, **flash_kwargs)),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(lambda q, k, v: ref_attn(
+        q, k, v, causal=causal, kv_mask=mask)), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=GTOL, atol=GTOL)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("use_mask", [False, True])
 def test_forward_matches_reference(causal, use_mask):
@@ -76,19 +92,7 @@ def test_gradients_match_reference():
     q, k, v = _qkv()
     rng = np.random.RandomState(1)
     mask = jnp.asarray(rng.rand(B, L) > 0.2).at[:, 0].set(True)
-
-    def loss(fn):
-        return lambda q, k, v: jnp.sum(
-            jnp.sin(fn(q, k, v)).astype(jnp.float32))
-
-    gf = jax.grad(loss(lambda q, k, v: flash_attention(
-        q, k, v, causal=True, kv_mask=mask, block_q=128, block_k=128)),
-        argnums=(0, 1, 2))(q, k, v)
-    gr = jax.grad(loss(lambda q, k, v: ref_attn(
-        q, k, v, causal=True, kv_mask=mask)), argnums=(0, 1, 2))(q, k, v)
-    for a, b in zip(gf, gr):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=GTOL, atol=GTOL)
+    _check_grads(q, k, v, True, mask, block_q=128, block_k=128)
 
 
 def test_odd_length_padding_and_bf16():
@@ -122,19 +126,7 @@ def test_two_pass_backward_matches_reference(monkeypatch):
     q, k, v = _qkv()
     rng = np.random.RandomState(1)
     mask = jnp.asarray(rng.rand(B, L) > 0.2).at[:, 0].set(True)
-
-    def loss(fn):
-        return lambda q, k, v: jnp.sum(
-            jnp.sin(fn(q, k, v)).astype(jnp.float32))
-
-    gf = jax.grad(loss(lambda q, k, v: flash_attention(
-        q, k, v, causal=True, kv_mask=mask, block_q=128, block_k=128)),
-        argnums=(0, 1, 2))(q, k, v)
-    gr = jax.grad(loss(lambda q, k, v: ref_attn(
-        q, k, v, causal=True, kv_mask=mask)), argnums=(0, 1, 2))(q, k, v)
-    for a, b in zip(gf, gr):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=GTOL, atol=GTOL)
+    _check_grads(q, k, v, True, mask, block_q=128, block_k=128)
 
 
 def test_fully_masked_rows_emit_zeros():
@@ -194,3 +186,30 @@ def test_long_sequence_default_blocks_match_oracle():
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(ref, np.float32),
                                rtol=RTOL, atol=ATOL)
+
+
+@pytest.mark.parametrize("bq,bk", [(64, 128), (256, 128), (128, 256)])
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("use_mask", [False, True])
+def test_unequal_blocks_fuzz(bq, bk, causal, use_mask):
+    """Sweep the (causal x has_bias x block-shape) kernel dispatch matrix
+    with UNEQUAL q/k blocks: the straddle predicate, the exp-underflow
+    masked-entry zeroing, and the no-bias fast path must all hold when
+    a block can contain rows with zero visible keys (bq > bk) or keys
+    spanning several diagonals (bk > bq).  Forward and gradients vs the
+    jnp oracle; L=192 pads to lcm(bq, bk).  (Block sizes must be legal
+    post-round-up — block_k below 128 is silently raised to 128, so
+    bq > bk regimes use bq = 256.)"""
+    l = 192
+    q, k, v = _qkv(l=l, seed=7)
+    mask = None
+    if use_mask:
+        rng = np.random.RandomState(2)
+        mask = jnp.asarray(rng.rand(B, l) > 0.3).at[:, 0].set(True)
+
+    out = flash_attention(q, k, v, causal=causal, kv_mask=mask,
+                          block_q=bq, block_k=bk)
+    ref = ref_attn(q, k, v, causal=causal, kv_mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=RTOL, atol=ATOL)
+    _check_grads(q, k, v, causal, mask, block_q=bq, block_k=bk)
