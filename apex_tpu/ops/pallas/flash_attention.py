@@ -381,10 +381,13 @@ def _flash_bwd_fused(qf, kf, vf, of, do_f, lse, bias, dlse_f, *, causal,
     return dq, dk, dv
 
 
-#: fused backward needs an (nk, BH, L, d) fp32 dq-partials buffer; above
-#: this many k blocks the extra HBM outweighs the saved recompute and the
-#: two-pass kernels take over (long-context / ring shards).
-_FUSED_BWD_MAX_NK = 8
+#: fused backward needs an (nk, BH, L, d) fp32 dq-partials buffer; the
+#: gate is its size, not the block count — fused still wins at nk=16
+#: when the buffer fits (gpt-small-tpu L=16384: 805 MB partials, +6%
+#: step throughput over two-pass).  Above this budget the extra HBM
+#: outweighs the saved recompute and the two-pass kernels take over
+#: (extreme contexts / big batches).
+_FUSED_BWD_MAX_BYTES = 1 << 30
 
 
 def _pad_bhld(t, lp):
@@ -565,7 +568,8 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, has_bias, saved,
     dlse_f = jnp.moveaxis(dlse.astype(jnp.float32), 1, 2).reshape(b * h, l)
     if lp != l:
         dlse_f = jnp.pad(dlse_f, ((0, 0), (0, lp - l)))
-    bwd = (_flash_bwd_fused if lp // block_k <= _FUSED_BWD_MAX_NK
+    partials_bytes = (lp // block_k) * qf.shape[0] * lp * d * 4
+    bwd = (_flash_bwd_fused if partials_bytes <= _FUSED_BWD_MAX_BYTES
            else _flash_bwd)
     dqf, dkf, dvf = bwd(qf, kf, vf, of, do_f, lse, bias_p, dlse_f,
                         causal=causal, has_bias=has_bias,

@@ -115,7 +115,7 @@ def bench_resnet(opt_level: str, batch: int, size: int, warmup: int,
 
 
 def bench_gpt(batch: int, seq: int, warmup: int, iters: int, peak: float,
-              tiny: bool, tpu_heads: bool = False):
+              tiny: bool, tpu_heads: bool = False, remat: bool = False):
     import dataclasses
 
     from apex_tpu import amp
@@ -127,6 +127,8 @@ def bench_gpt(batch: int, seq: int, warmup: int, iters: int, peak: float,
     # geometry (full MXU lane width in the flash kernels).
     cfg = gpt_tiny() if tiny else (
         gpt_small_tpu() if tpu_heads else gpt_small())
+    if remat:  # long-context configs recompute the layer body
+        cfg = dataclasses.replace(cfg, remat=True)
     model = GPTModel(cfg)
     ids = jax.random.randint(jax.random.PRNGKey(3), (batch, seq), 0,
                              cfg.vocab_size)
@@ -288,6 +290,11 @@ def main():
         # so it would just duplicate gpt_small_o2 under another name
         record("gpt_small_tpu_heads_o2", bench_gpt, tpu_heads=True,
                **gpt_args)
+        # long-context single-chip: flash + remat keep the (L, L) scores
+        # and activations out of HBM at 8K tokens of context
+        record("gpt_small_tpu_heads_L8192_o2", bench_gpt, tpu_heads=True,
+               remat=True, batch=2, seq=8192, warmup=3, iters=15,
+               tiny=False)
     record("bert_large_lamb_o2", bench_bert, **bert_args)
     if on_tpu:
         record("bert_large_tpu_heads_lamb_o2", bench_bert, tpu_heads=True,

@@ -119,10 +119,10 @@ def test_noncausal_padded_keys_do_not_attend():
 
 def test_two_pass_backward_matches_reference(monkeypatch):
     """The long-context two-pass backward (dq + dkv kernels) is the
-    fallback above _FUSED_BWD_MAX_NK k-blocks; force it here so both
-    backward implementations keep gradient coverage."""
+    fallback above the _FUSED_BWD_MAX_BYTES dq-partials budget; force it
+    here so both backward implementations keep gradient coverage."""
     from apex_tpu.ops.pallas import flash_attention as fa
-    monkeypatch.setattr(fa, "_FUSED_BWD_MAX_NK", 0)
+    monkeypatch.setattr(fa, "_FUSED_BWD_MAX_BYTES", 0)
     q, k, v = _qkv()
     rng = np.random.RandomState(1)
     mask = jnp.asarray(rng.rand(B, L) > 0.2).at[:, 0].set(True)
