@@ -14,9 +14,13 @@ FlashAttention — pattern, not code) mapped onto the TPU:
 - score/softmax arithmetic is fp32 regardless of storage dtype (the amp
   blacklist rule for softmax), matmuls ride the MXU with
   ``preferred_element_type=float32``;
-- the backward is the standard two-pass recomputation from the saved
-  logsumexp: a ``dq`` pass (k innermost) and a ``dk/dv`` pass (q
-  innermost), each one Pallas call — no ``(L, L)`` tensor ever hits HBM.
+- the backward recomputes probability blocks from the saved logsumexp;
+  by default one fused pass produces dq, dk and dv together (dk/dv
+  accumulate in VMEM scratch, dq lands in per-k-block fp32 partial
+  planes summed outside — see ``_FUSED_BWD_MAX_BYTES``), falling back
+  to the classic two-pass scheme (a ``dq`` pass with k innermost, a
+  ``dk/dv`` pass with q innermost) when the partials buffer would
+  exceed the budget.  No ``(L, L)`` tensor ever hits HBM either way.
 
 Masking: ``kv_mask`` (key padding) arrives as an additive fp32 bias row
 ``(B, L)`` (0 = attend, ``NEG_INF`` = ignore); causal masking is computed

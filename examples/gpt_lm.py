@@ -24,7 +24,10 @@ import time
 
 def parse_args():
     p = argparse.ArgumentParser()
-    p.add_argument("--size", default="tiny", choices=["tiny", "small"])
+    p.add_argument("--size", default="tiny",
+                   choices=["tiny", "small", "small-tpu"],
+                   help="small-tpu = gpt-small with the TPU-native 6x128 "
+                        "head geometry (same params, ~30%% faster steps)")
     p.add_argument("--seq-len", type=int, default=256)
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--steps", type=int, default=50)
@@ -57,10 +60,12 @@ def main():
     import numpy as np
 
     from apex_tpu import amp
-    from apex_tpu.models.gpt import GPTModel, gpt_small, gpt_tiny, lm_loss
+    from apex_tpu.models.gpt import (
+        GPTModel, gpt_small, gpt_small_tpu, gpt_tiny, lm_loss)
     from apex_tpu.optimizers import FusedAdam
 
-    cfg = (gpt_tiny if args.size == "tiny" else gpt_small)()
+    cfg = {"tiny": gpt_tiny, "small": gpt_small,
+           "small-tpu": gpt_small_tpu}[args.size]()
     cfg = dataclasses.replace(cfg, remat=args.remat,
                               scan_layers=args.scan_layers)
 
