@@ -59,6 +59,14 @@ def gpt_small_tpu() -> GPTConfig:
     return GPTConfig(num_heads=6)
 
 
+def gpt_medium_tpu() -> GPTConfig:
+    """gpt-medium (~368M params) with TPU-native 8x128 heads.  The
+    bigger matmuls lift single-chip MFU past the small model (measured
+    53% at B8·L2048 amp O2 on v5e, 43.4K tok/s)."""
+    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=8,
+                     intermediate_size=4096)
+
+
 def gpt_tiny() -> GPTConfig:
     """Test-scale config."""
     return GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,

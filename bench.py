@@ -115,18 +115,25 @@ def bench_resnet(opt_level: str, batch: int, size: int, warmup: int,
 
 
 def bench_gpt(batch: int, seq: int, warmup: int, iters: int, peak: float,
-              tiny: bool, tpu_heads: bool = False, remat: bool = False):
+              tiny: bool, tpu_heads: "bool | str" = False,
+              remat: bool = False):
     import dataclasses
 
     from apex_tpu import amp
     from apex_tpu.models.gpt import (
-        GPTModel, gpt_small, gpt_small_tpu, gpt_tiny, lm_loss)
+        GPTModel, gpt_medium_tpu, gpt_small, gpt_small_tpu, gpt_tiny,
+        lm_loss)
     from apex_tpu.optimizers import FusedAdam
 
     # tpu_heads: same params/FLOPs with the TPU-native 6x128 head
-    # geometry (full MXU lane width in the flash kernels).
-    cfg = gpt_tiny() if tiny else (
-        gpt_small_tpu() if tpu_heads else gpt_small())
+    # geometry (full MXU lane width in the flash kernels); the string
+    # "medium" selects gpt_medium_tpu (~368M, 8x128 heads) instead.
+    if tiny:
+        cfg = gpt_tiny()
+    elif tpu_heads == "medium":
+        cfg = gpt_medium_tpu()
+    else:
+        cfg = gpt_small_tpu() if tpu_heads else gpt_small()
     if remat:  # long-context configs recompute the layer body
         cfg = dataclasses.replace(cfg, remat=True)
     model = GPTModel(cfg)
@@ -295,6 +302,9 @@ def main():
         record("gpt_small_tpu_heads_L8192_o2", bench_gpt, tpu_heads=True,
                remat=True, batch=2, seq=8192, warmup=3, iters=15,
                tiny=False)
+        # bigger matmuls lift MFU: ~368M params, 8x128 heads
+        record("gpt_medium_tpu_o2", bench_gpt, tpu_heads="medium",
+               batch=8, seq=2048, warmup=3, iters=12, tiny=False)
     record("bert_large_lamb_o2", bench_bert, **bert_args)
     if on_tpu:
         record("bert_large_tpu_heads_lamb_o2", bench_bert, tpu_heads=True,
