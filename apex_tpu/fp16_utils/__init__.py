@@ -20,12 +20,14 @@ from apex_tpu.fp16_utils.fp16util import (
 )
 from apex_tpu.fp16_utils.loss_scaler import DynamicLossScaler, LossScaler
 
-# Reference-spelling aliases (fp16util.py:7-41).
+# Reference-spelling aliases (fp16util.py:7-41; fp16_optimizer.py class
+# name with the underscore, `apex/fp16_utils/__init__.py:14`).
 tofp16 = tree_to_half
 network_to_half = tree_to_half
+FP16_Optimizer = FP16Optimizer
 
 __all__ = [
-    "FP16Optimizer", "FP16OptimizerState",
+    "FP16Optimizer", "FP16OptimizerState", "FP16_Optimizer",
     "BN_convert_float", "FP16Model", "clip_grad_norm", "convert_module",
     "convert_network",
     "master_params_to_model_params", "model_grads_to_master_grads",
