@@ -190,6 +190,10 @@ def _load_pysrc_corpus(max_bytes=8 << 20):
 def _sample_windows(corpus, rng, b, l):
     import jax.numpy as jnp
     import numpy as np
+    if len(corpus) < l + 2:
+        raise SystemExit(
+            f"pysrc corpus has {len(corpus)} bytes, too small for "
+            f"--seq-len {l} (zipped stdlib? try a smaller sequence)")
     starts = rng.randint(0, len(corpus) - l - 1, size=b)
     return jnp.asarray(np.stack([corpus[s:s + l] for s in starts])
                        .astype(np.int32))

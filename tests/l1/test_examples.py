@@ -40,6 +40,11 @@ CASES = {
     "pipeline_moe.py --mode pp": ["--mode", "pp", "--steps", "2"],
     "gpt_lm.py": ["--steps", "2", "--seq-len", "64", "--batch-size", "2",
                   "--seq-parallel", "--devices", "4", "--force-cpu"],
+    # real text: byte-level LM over the stdlib sources + greedy sample
+    "gpt_lm.py --data pysrc": [
+        "--data", "pysrc", "--steps", "2", "--seq-len", "64",
+        "--batch-size", "2", "--sample-bytes", "4", "--force-cpu",
+        "--devices", "1"],
 }
 
 
