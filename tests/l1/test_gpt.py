@@ -110,3 +110,30 @@ class TestGPT:
         half = lm_loss(logits[:, :-1], self.ids[:, 1:], mask=mask)
         assert float(full) != float(half)
         assert np.isfinite(float(half))
+
+
+def test_tpu_head_geometry_same_params():
+    """The TPU-native config factories change only the head split:
+    head_dim 128 (full MXU lane width) at an identical parameter count
+    to the conventional shapes — the claim behind gpt_small_tpu /
+    gpt_medium_tpu / bert_large_tpu (docs/source/attention.rst)."""
+    from apex_tpu.models.bert import (
+        BertForPreTraining, bert_large, bert_large_tpu)
+    from apex_tpu.models.gpt import gpt_medium_tpu, gpt_small, gpt_small_tpu
+
+    def n_params(init_fn):
+        shapes = jax.eval_shape(init_fn)["params"]
+        return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(shapes))
+
+    def count(model_cls, cfg):
+        m = model_cls(cfg)
+        return n_params(lambda: m.init(
+            jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32)))
+
+    for model_cls, conv, tpu in (
+            (GPTModel, gpt_small(), gpt_small_tpu()),
+            (BertForPreTraining, bert_large(), bert_large_tpu())):
+        assert tpu.hidden_size // tpu.num_heads == 128
+        assert count(model_cls, conv) == count(model_cls, tpu)
+    med = gpt_medium_tpu()
+    assert med.hidden_size // med.num_heads == 128
