@@ -3,7 +3,8 @@
 The reference ships the LAMB kernels with no driver (SURVEY.md §0); this is
 the end-to-end pretraining loop those kernels exist for.  Synthetic masked-LM
 data by default; ``--size large`` selects BERT-large (the v5e-16 config),
-``--size tiny`` runs anywhere.
+``--size large-tpu`` the same model with the TPU-native 8x128 head geometry
+(same parameter count, ~20% faster steps), ``--size tiny`` runs anywhere.
 
 Data-parallel over all devices with ``--dp`` (shard_map over ("data",)).
 """
@@ -26,6 +27,7 @@ from apex_tpu.models.bert import (
     BertForPreTraining,
     bert_base,
     bert_large,
+    bert_large_tpu,
     bert_tiny,
     pretraining_loss,
 )
@@ -33,7 +35,10 @@ from apex_tpu.optimizers import fused_lamb
 from apex_tpu.parallel import DistributedDataParallel, data_parallel_mesh
 from apex_tpu.utils import maybe_print
 
-CONFIGS = {"tiny": bert_tiny, "base": bert_base, "large": bert_large}
+# "large-tpu" = bert-large with the TPU-native 8x128 head geometry (same
+# parameter count, ~20% faster pretraining steps on v5e)
+CONFIGS = {"tiny": bert_tiny, "base": bert_base, "large": bert_large,
+           "large-tpu": bert_large_tpu}
 
 
 def parse_args():
