@@ -36,13 +36,14 @@ from apex_tpu.models.resnet import (
     ResNet18,
     ResNet34,
     ResNet50,
+    ResNet50S2D,
     ResNet101,
     ResNet152,
 )
 
 __all__ = [
     "MLP", "AmpDense", "cross_entropy_loss",
-    "ResNet", "ResNet50", "ResNet18", "ResNet34", "ResNet101", "ResNet152",
+    "ResNet", "ResNet50", "ResNet50S2D", "ResNet18", "ResNet34", "ResNet101", "ResNet152",
     "ARCHS", "BasicBlock", "Bottleneck",
     "BertConfig", "BertModel", "BertForPreTraining",
     "bert_large", "bert_base", "bert_tiny", "pretraining_loss",

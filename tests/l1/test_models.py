@@ -49,6 +49,24 @@ class TestResNet:
             train=True, mutable=["batch_stats"])[0]))(variables["params"])
         assert all(bool(jnp.isfinite(l).all()) for l in jax.tree.leaves(g))
 
+    def test_s2d_stem_variant(self):
+        """The TPU-native space-to-depth stem keeps the stage geometry
+        (same output head, spatial/4 stem output) and trains; non-
+        divisible spatial dims fail loudly."""
+        from apex_tpu.models.resnet import ResNet50S2D
+        model = ResNet50S2D(num_classes=10, width=8)
+        x = self.x
+        variables = model.init(jax.random.PRNGKey(0), x, train=True)
+        # stem conv runs on the 16x-channel space-to-depth reshuffle
+        assert variables["params"]["stem_conv"]["kernel"].shape == \
+            (2, 2, 48, 8)
+        logits, _ = model.apply(variables, x, train=True,
+                                mutable=["batch_stats"])
+        assert logits.shape == (4, 10)
+        assert bool(jnp.isfinite(logits).all())
+        with pytest.raises(ValueError, match="divisible by 4"):
+            model.init(jax.random.PRNGKey(0), x[:, :30], train=True)
+
     def init(self):
         return self.model.init(jax.random.PRNGKey(0), self.x, train=True)
 
