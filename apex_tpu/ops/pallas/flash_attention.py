@@ -17,7 +17,7 @@ FlashAttention — pattern, not code) mapped onto the TPU:
 - the backward recomputes probability blocks from the saved logsumexp;
   by default one fused pass produces dq, dk and dv together (dk/dv
   accumulate in VMEM scratch, dq lands in per-k-block fp32 partial
-  planes summed outside — see ``_FUSED_BWD_MAX_BYTES``), falling back
+  planes summed outside — see ``_fused_bwd_max_bytes``), falling back
   to the classic two-pass scheme (a ``dq`` pass with k innermost, a
   ``dk/dv`` pass with q innermost) when the partials buffer would
   exceed the budget.  No ``(L, L)`` tensor ever hits HBM either way.
@@ -385,13 +385,27 @@ def _flash_bwd_fused(qf, kf, vf, of, do_f, lse, bias, dlse_f, *, causal,
     return dq, dk, dv
 
 
-#: fused backward needs an (nk, BH, L, d) fp32 dq-partials buffer; the
-#: gate is its size, not the block count — fused still wins at nk=16
-#: when the buffer fits (gpt-small-tpu L=16384: 805 MB partials, +6%
-#: step throughput over two-pass).  Above this budget the extra HBM
-#: outweighs the saved recompute and the two-pass kernels take over
-#: (extreme contexts / big batches).
-_FUSED_BWD_MAX_BYTES = 1 << 30
+def _fused_bwd_max_bytes() -> int:
+    """HBM budget for the fused backward's (groups, BH, L, d) fp32
+    dq-partials buffer; the gate is its size, not the block count —
+    fused still wins at nk=16 when the buffer fits (gpt-small-tpu
+    L=16384: 805 MB partials, +6% step throughput over two-pass).
+    Above this budget the extra HBM outweighs the saved recompute and
+    the two-pass kernels take over (extreme contexts / big batches).
+
+    ``APEX_TPU_FLASH_FUSED_BWD_MAX_BYTES`` overrides (0 forces the
+    two-pass path) so memory-tight configs can steer without
+    monkeypatching."""
+    import os
+    env = os.environ.get("APEX_TPU_FLASH_FUSED_BWD_MAX_BYTES")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            raise ValueError(
+                f"APEX_TPU_FLASH_FUSED_BWD_MAX_BYTES must be a plain "
+                f"integer byte count, got {env!r}") from None
+    return 1 << 30
 
 
 def _pad_bhld(t, lp):
@@ -573,7 +587,7 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, has_bias, saved,
     if lp != l:
         dlse_f = jnp.pad(dlse_f, ((0, 0), (0, lp - l)))
     partials_bytes = (lp // block_k) * qf.shape[0] * lp * d * 4
-    bwd = (_flash_bwd_fused if partials_bytes <= _FUSED_BWD_MAX_BYTES
+    bwd = (_flash_bwd_fused if partials_bytes <= _fused_bwd_max_bytes()
            else _flash_bwd)
     dqf, dkf, dvf = bwd(qf, kf, vf, of, do_f, lse, bias_p, dlse_f,
                         causal=causal, has_bias=has_bias,
@@ -631,6 +645,20 @@ def _default_block(l: int) -> int:
     return 512
 
 
+@functools.lru_cache(maxsize=None)
+def _warn_block_override(name: str, asked: int, got: int) -> None:
+    """Once per distinct (name, asked, got): explicit block sizes are
+    silently clamped/rounded to Mosaic tile granularity, which changes
+    the blocking a tuner asked for — surface it (ADVICE r2)."""
+    import warnings
+    warnings.warn(
+        f"flash_attention: {name}={asked} adjusted to {got} "
+        f"(clamped to the padded sequence length and rounded to Mosaic "
+        f"tile granularity: block_q to a multiple of 8, block_k to a "
+        f"multiple of 128 — sub-128 k blocks miscompile on TPU)",
+        stacklevel=3)
+
+
 def _varying(x) -> bool:
     try:
         return bool(jax.typeof(x).vma)
@@ -675,6 +703,7 @@ def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
         # merge algebra.
         return _jnp_attention(q, k, v, causal=causal, kv_mask=kv_mask,
                               scale=float(scale), return_lse=return_lse)
+    explicit = (block_q, block_k)
     if block_q is None:
         block_q = _default_block(l)
     if block_k is None:
@@ -688,6 +717,10 @@ def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
     # VPU anyway — round both up to legal sizes.
     block_q = max(8, _ceil_to(int(block_q), 8))
     block_k = max(_LANES, _ceil_to(int(block_k), _LANES))
+    for name, asked, got in (("block_q", explicit[0], block_q),
+                             ("block_k", explicit[1], block_k)):
+        if asked is not None and int(asked) != got:
+            _warn_block_override(name, int(asked), got)
     if kv_mask is not None:
         bias = jnp.where(kv_mask, 0.0, NEG_INF).astype(jnp.float32)
     else:

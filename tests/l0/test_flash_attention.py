@@ -117,12 +117,36 @@ def test_noncausal_padded_keys_do_not_attend():
                                rtol=RTOL, atol=ATOL)
 
 
+def test_explicit_block_override_warns():
+    """Explicit sub-granularity block sizes are rounded up to Mosaic
+    tile legality (block_k < 128 miscompiles on hardware); the caller
+    asked for a specific blocking, so the adjustment must be audible
+    (ADVICE r2)."""
+    import warnings
+    from apex_tpu.ops.pallas import flash_attention as fa
+    fa._warn_block_override.cache_clear()
+    q, k, v = _qkv(l=256)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        flash_attention(q, k, v, block_q=100, block_k=64)
+    msgs = [str(w.message) for w in caught
+            if "adjusted to" in str(w.message)]
+    assert any("block_q=100 adjusted to 104" in m for m in msgs)
+    assert any("block_k=64 adjusted to 128" in m for m in msgs)
+    # Defaulted block sizes never warn.
+    fa._warn_block_override.cache_clear()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        flash_attention(q, k, v)
+    assert not [w for w in caught if "adjusted to" in str(w.message)]
+
+
 def test_two_pass_backward_matches_reference(monkeypatch):
     """The long-context two-pass backward (dq + dkv kernels) is the
-    fallback above the _FUSED_BWD_MAX_BYTES dq-partials budget; force it
-    here so both backward implementations keep gradient coverage."""
-    from apex_tpu.ops.pallas import flash_attention as fa
-    monkeypatch.setattr(fa, "_FUSED_BWD_MAX_BYTES", 0)
+    fallback above the fused dq-partials budget; force it here (via the
+    public env override) so both backward implementations keep gradient
+    coverage."""
+    monkeypatch.setenv("APEX_TPU_FLASH_FUSED_BWD_MAX_BYTES", "0")
     q, k, v = _qkv()
     rng = np.random.RandomState(1)
     mask = jnp.asarray(rng.rand(B, L) > 0.2).at[:, 0].set(True)

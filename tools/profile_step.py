@@ -39,9 +39,57 @@ def build(model_name: str, opt_level: str):
     return fn
 
 
+def parse_trace_json(logdir: str):
+    """Lossy fallback: aggregate the chrome-trace JSON export (op-level
+    events can be missing for large programs — prefer the xplane)."""
+    import gzip
+    by_name = collections.Counter()
+    by_cat = collections.Counter()
+    total = 0
+    for path in glob.glob(f"{logdir}/**/*.trace.json.gz", recursive=True):
+        trace = json.loads(gzip.open(path, "rt").read())
+        events = trace.get("traceEvents", [])
+        # Mirror parse_xplane's filter: only the device planes' "XLA Ops"
+        # line (metadata events map pid -> process/plane name and
+        # (pid, tid) -> thread/line name); counting every complete event
+        # would double-count ops inside step markers and mix in host
+        # threads.
+        proc = {}
+        thread = {}
+        for ev in events:
+            if ev.get("ph") != "M":
+                continue
+            name = ev.get("args", {}).get("name", "")
+            if ev.get("name") == "process_name":
+                proc[ev.get("pid")] = name
+            elif ev.get("name") == "thread_name":
+                thread[(ev.get("pid"), ev.get("tid"))] = name
+        for ev in events:
+            if ev.get("ph") != "X" or "dur" not in ev:
+                continue
+            if not proc.get(ev.get("pid"), "").startswith("/device:"):
+                continue
+            if thread.get((ev.get("pid"), ev.get("tid"))) != "XLA Ops":
+                continue
+            d = int(ev["dur"] * 1e6)            # us -> ps, match xplane
+            by_name[ev.get("name", "?")] += d
+            by_cat[ev.get("args", {}).get("hlo_category", "?")] += d
+            total += d
+    return by_name, by_cat, total
+
+
 def parse_xplane(logdir: str):
-    """Aggregate device-plane op durations from the xplane protobuf."""
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    """Aggregate device-plane op durations from the xplane protobuf.
+    Falls back to the lossy chrome-trace JSON when the tensorflow/tsl
+    xplane proto is not importable (ADVICE r2)."""
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except ImportError as e:
+        print(f"warning: xplane proto unavailable ({e}); falling back to "
+              f"the lossy chrome-trace JSON parser (install tensorflow "
+              f"for the complete tsl xplane protobuf path)",
+              file=sys.stderr)
+        return parse_trace_json(logdir)
 
     paths = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
     by_name = collections.Counter()
