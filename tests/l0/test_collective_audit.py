@@ -1,0 +1,40 @@
+"""The dryrun's HLO collective audit (``__graft_entry__._collective_audit``)
+is the communication-volume regression surface for multi-chip configs
+(VERDICT r2 item 8): pin its parsing against representative compiled-HLO
+spellings — sync ops, async start/done pairs (counted once), and tuple
+shapes — so audit numbers stay trustworthy."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from __graft_entry__ import _collective_audit  # noqa: E402
+
+
+HLO = """
+  %all-reduce.1 = f32[8,16]{1,0} all-reduce(f32[8,16]{1,0} %p), to_apply=%add
+  %ag-start = (f32[4]{0}, f32[32]{0}) all-gather-start(f32[4]{0} %x), dimensions={0}
+  %ag-done = f32[32]{0} all-gather-done((f32[4]{0}, f32[32]{0}) %ag-start)
+  %cp = bf16[2,8]{1,0} collective-permute(bf16[2,8]{1,0} %y), source_target_pairs={{0,1}}
+  %a2a = (f32[16]{0}) all-to-all(f32[16]{0} %z), dimensions={0}
+  %rs = f32[4]{0} reduce-scatter(f32[32]{0} %w), dimensions={0}, to_apply=%add
+  %not-a-collective = f32[8]{0} add(f32[8]{0} %a, f32[8]{0} %b)
+"""
+
+
+def test_counts_and_bytes():
+    audit = _collective_audit(HLO)
+    assert audit["all-reduce"] == {"count": 1, "bytes": 8 * 16 * 4}
+    # async pair: only the -start's largest tuple element (the result
+    # buffer) counts, so sync and async spellings audit identically,
+    # and the -done is skipped
+    assert audit["all-gather"] == {"count": 1, "bytes": 32 * 4}
+    assert audit["collective-permute"] == {"count": 1, "bytes": 2 * 8 * 2}
+    assert audit["all-to-all"] == {"count": 1, "bytes": 16 * 4}
+    assert audit["reduce-scatter"] == {"count": 1, "bytes": 4 * 4}
+    assert "add" not in audit and len(audit) == 5
+
+
+def test_empty_program_has_no_collectives():
+    assert _collective_audit("%r = f32[2]{0} add(%a, %b)") == {}
