@@ -572,6 +572,11 @@ def make_train_step(
 
             zero = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params_c)
+            if axis_name is not None:
+                # under shard_map the per-rank grads are device-varying;
+                # fresh zeros are not — mark them varying so the scan
+                # carry types agree (grads stay per-rank until reduce_fn)
+                zero = pvary_params(zero, axis_name)
             grads, (losses, auxes) = jax.lax.scan(body, zero,
                                                   micro_batches)
             # mean-loss semantics: the accumulated step equals the

@@ -22,6 +22,16 @@ HLO = """
   %not-a-collective = f32[8]{0} add(f32[8]{0} %a, f32[8]{0} %b)
 """
 
+# Real-TPU spellings: tiled layouts embed parentheses inside the shape
+# ({0:T(256)}), and async reduce-scatter's result is the SMALLEST tuple
+# element (the operand is world_size x bigger).
+HLO_TPU = """
+  %ag2-start = (f32[4]{0:T(256)}, f32[32]{0:T(256)}) all-gather-start(f32[4]{0:T(256)} %x), dimensions={0}
+  %ag2-done = f32[32]{0:T(256)} all-gather-done((f32[4]{0:T(256)}, f32[32]{0:T(256)}) %ag2-start)
+  %rs2-start = (f32[32]{0:T(256)}, f32[4]{0:T(256)}) reduce-scatter-start(f32[32]{0:T(256)} %w), dimensions={0}, to_apply=%add
+  %rs2-done = f32[4]{0:T(256)} reduce-scatter-done((f32[32]{0:T(256)}, f32[4]{0:T(256)}) %rs2-start)
+"""
+
 
 def test_counts_and_bytes():
     audit = _collective_audit(HLO)
@@ -38,3 +48,12 @@ def test_counts_and_bytes():
 
 def test_empty_program_has_no_collectives():
     assert _collective_audit("%r = f32[2]{0} add(%a, %b)") == {}
+
+
+def test_tpu_tiled_layouts_and_async_reduce_scatter():
+    """Async spellings with tiled layouts must audit the same bytes as
+    their sync equivalents — all-gather picks the largest tuple element,
+    reduce-scatter the smallest (its result is the small buffer)."""
+    audit = _collective_audit(HLO_TPU)
+    assert audit["all-gather"] == {"count": 1, "bytes": 32 * 4}
+    assert audit["reduce-scatter"] == {"count": 1, "bytes": 4 * 4}
