@@ -216,7 +216,27 @@ dot = half_function(jnp.dot)
 tensordot = half_function(jnp.tensordot)
 einsum = half_function(jnp.einsum)
 dot_general = half_function(lax.dot_general)
-conv_general_dilated = half_function(lax.conv_general_dilated)
+
+
+def _conv_general_dilated(x, kernel, window_strides, padding,
+                          lhs_dilation=None, rhs_dilation=None,
+                          dimension_numbers=None, **kwargs):
+    """lax.conv_general_dilated signature, with eligible 1x1 stride-1
+    NHWC convs routed to the fused-backward kernel when opted in (the
+    RN50 conv-MFU campaign — see :mod:`apex_tpu.ops.pallas.conv1x1`)."""
+    from apex_tpu.ops.pallas import conv1x1 as c1
+    if (lhs_dilation is None and rhs_dilation is None
+            and c1.routeable(x, kernel, window_strides, padding,
+                             dimension_numbers, kwargs)):
+        return c1.conv1x1(x, kernel)
+    return lax.conv_general_dilated(x, kernel, window_strides, padding,
+                                    lhs_dilation=lhs_dilation,
+                                    rhs_dilation=rhs_dilation,
+                                    dimension_numbers=dimension_numbers,
+                                    **kwargs)
+
+
+conv_general_dilated = half_function(_conv_general_dilated)
 conv_transpose = half_function(lax.conv_transpose)
 
 
@@ -245,9 +265,10 @@ def _conv(x, kernel, bias=None, *, window_strides=None, padding="SAME",
                 f"= {spatial} spatial); give dimension_numbers explicitly")
         chars = "DHW"[-spatial:]
         dimension_numbers = (f"N{chars}C", f"{chars}IO", f"N{chars}C")
-    y = lax.conv_general_dilated(x, kernel, window_strides=window_strides,
-                                 padding=padding,
-                                 dimension_numbers=dimension_numbers, **kw)
+    # one routing point: eligible 1x1 cases reach the fused-backward
+    # kernel through the same dispatch as ops.conv_general_dilated
+    y = _conv_general_dilated(x, kernel, window_strides, padding,
+                              dimension_numbers=dimension_numbers, **kw)
     if bias is not None:
         y = y + bias
     return y

@@ -1,0 +1,187 @@
+"""Fused one-pass backward for 1x1 stride-1 NHWC convolutions.
+
+The RN50 profile (``tools/conv_attrib.py``, round 3) shows the early-stage
+1x1 convolutions are HBM-bound and their backward runs far below the
+memory roofline: XLA emits separate dgrad and wgrad convolutions, reading
+the (large) ``dy`` twice, and its small-channel conv kernels leave a
+further ~2x on the floor (stage0 1x1 bwd measured 0.09 MFU vs a 0.2
+roofline ceiling; stage2/3 equivalents reach 0.4-0.7).
+
+A 1x1 stride-1 conv is a matmul over the flattened ``(B*H*W, C)`` view,
+so its whole backward is two matmuls sharing ``dy``:
+
+    dx = dy @ W^T          (M, cout) x (cout, cin)
+    dW = x^T @ dy          (cin, M) x (M, cout), accumulated over M tiles
+
+This kernel walks M tiles once, computing the ``dx`` tile and
+accumulating ``dW`` in a VMEM fp32 scratch — ``dy`` and ``x`` are each
+read exactly once, at memory roofline, independent of channel count.
+The forward stays on the XLA conv (already roofline-bound; nothing to
+win there).  The reference has no analog (cuDNN fuses neither).
+
+**Measured result (round 3, v5e, RN50 b256): the kernel wins in
+isolation but LOSES in the model, so it is OFF by default.**  Each
+fused call beats the XLA dgrad+wgrad pair (~1.53 ms vs ~1.7 ms for the
+stage0 shapes), but XLA fuses the surrounding elementwise chain (relu
+mask, BN-backward pieces, is-finite checks) directly into its conv
+operands; routing the backward into a custom call forces those
+producers/consumers into separate materialized passes and the whole
+step regresses 106 -> 168 ms.  A future variant would have to absorb
+the BN-backward epilogue to pay for the fusion boundary.  Kept opt-in
+(``APEX_TPU_FUSED_CONV1X1=1``) with numerics pinned by
+``tests/l0/test_conv1x1.py``.
+
+Routing: :func:`conv1x1` is invoked from ``apex_tpu.amp.ops`` for
+eligible convs (1x1 kernel, stride 1, NHWC, no dilation/groups) when
+enabled; non-TPU backends use the plain lax path
+(``apex_tpu.ops.use_pallas``).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops import on_tpu, sds as _sds, use_pallas
+
+_DN = ("NHWC", "HWIO", "NHWC")
+#: M-tile candidates, largest first; the tile must divide B*H*W exactly
+#: (no masking pass — remainder shapes fall back to the lax backward).
+_TILES = (4096, 2048, 1024, 512, 256, 128, 64, 32, 16, 8)
+
+
+def enabled() -> bool:
+    # default OFF: measured slower in-model (see module docstring)
+    return os.environ.get("APEX_TPU_FUSED_CONV1X1", "0") == "1" \
+        and use_pallas()
+
+
+def _pick_tile(m: int, cin: int, cout: int, itemsize: int):
+    """Largest tile that divides m AND fits the ~16 MB VMEM budget:
+    double-buffered x/dy/dx tiles + the fp32 dW scratch + W (measured
+    limit: tile 4096 at cin 512/cout 256 hit 20.75M > 16M on v5e)."""
+    fixed = 4 * cin * cout + itemsize * cin * cout
+    for t in _TILES:
+        tiles = 2 * itemsize * t * (2 * cin + cout)   # x+dx, dy, 2x buf
+        if m % t == 0 and tiles + fixed <= 10 * 1024 * 1024:
+            return t
+    return None
+
+
+def _bwd_kernel(x_ref, dy_ref, w_ref, dx_ref, dw_ref, dw_scr, *, nm):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_scr[...] = jnp.zeros_like(dw_scr)
+
+    dy = dy_ref[...]                                   # (tm, cout)
+    # dx tile: dy @ W^T — contraction over cout (the big channel dim for
+    # the expensive early-stage expansions), fp32 accumulation on the MXU
+    dx_ref[...] = lax.dot_general(
+        dy, w_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dx_ref.dtype)
+    # dW accumulation: x^T @ dy over the tile's M rows
+    dw_scr[...] += lax.dot_general(
+        x_ref[...], dy, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)            # (cin, cout)
+
+    @pl.when(i == nm - 1)
+    def _emit():
+        dw_ref[...] = dw_scr[...].astype(dw_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("tile",))
+def _bwd_fused(xm, dym, w2, *, tile):
+    m, cin = xm.shape
+    cout = dym.shape[1]
+    nm = m // tile
+    dx, dw = pl.pallas_call(
+        functools.partial(_bwd_kernel, nm=nm),
+        grid=(nm,),
+        in_specs=[
+            pl.BlockSpec((tile, cin), lambda i: (i, 0)),
+            pl.BlockSpec((tile, cout), lambda i: (i, 0)),
+            pl.BlockSpec((cin, cout), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile, cin), lambda i: (i, 0)),
+            pl.BlockSpec((cin, cout), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            _sds((m, cin), xm.dtype, xm, dym),
+            _sds((cin, cout), w2.dtype, xm, dym),
+        ],
+        scratch_shapes=[pltpu.VMEM((cin, cout), jnp.float32)],
+        interpret=not on_tpu(),
+    )(xm, dym, w2)
+    return dx, dw
+
+
+@jax.custom_vjp
+def conv1x1(x, w):
+    """1x1 stride-1 NHWC conv: XLA forward, fused Pallas backward.
+
+    ``x``: (B, H, W, cin); ``w``: (1, 1, cin, cout).
+    """
+    return lax.conv_general_dilated(x, w, (1, 1), "VALID",
+                                    dimension_numbers=_DN)
+
+
+def _fwd_rule(x, w):
+    return conv1x1(x, w), (x, w)
+
+
+def _bwd_rule(saved, dy):
+    x, w = saved
+    b, h, wd, cin = x.shape
+    cout = w.shape[-1]
+    m = b * h * wd
+    tile = _pick_tile(m, cin, cout, x.dtype.itemsize)
+    if tile is None:
+        # remainder-shaped inputs: the plain transpose (two lax convs)
+        _, vjp = jax.vjp(
+            lambda x_, w_: lax.conv_general_dilated(
+                x_, w_, (1, 1), "VALID", dimension_numbers=_DN), x, w)
+        return vjp(dy)
+    dx, dw = _bwd_fused(x.reshape(m, cin), dy.reshape(m, cout),
+                        w.reshape(cin, cout), tile=tile)
+    return dx.reshape(x.shape), dw.reshape(w.shape)
+
+
+conv1x1.defvjp(_fwd_rule, _bwd_rule)
+
+
+def routeable(x, kernel, window_strides, padding, dimension_numbers,
+              kwargs) -> bool:
+    """Is this conv an eligible 1x1 stride-1 NHWC case?"""
+    if not enabled() or kwargs:
+        return False
+    if getattr(x, "ndim", 0) != 4 or getattr(kernel, "ndim", 0) != 4:
+        return False
+    if kernel.shape[0] != 1 or kernel.shape[1] != 1:
+        return False
+    if tuple(window_strides) != (1, 1):
+        return False
+    # Only explicit NHWC/HWIO/NHWC routes: lax's None default means
+    # NCHW/OIHW-ordered operands, which this kernel would silently
+    # misinterpret as an NHWC matmul.
+    if dimension_numbers is None or tuple(dimension_numbers) != _DN:
+        return False
+    if x.dtype != kernel.dtype or x.dtype not in (jnp.bfloat16,
+                                                  jnp.float32,
+                                                  jnp.float16):
+        return False
+    # SAME == VALID for a 1x1/stride-1 window; explicit zero pads too.
+    if isinstance(padding, str):
+        return padding in ("SAME", "VALID")
+    try:
+        return all(tuple(p) == (0, 0) for p in padding)
+    except TypeError:
+        return False

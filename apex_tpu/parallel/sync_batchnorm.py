@@ -50,13 +50,25 @@ from jax import lax
 
 def welford_mean_var(x: jax.Array, reduce_axes: Sequence[int]):
     """Local per-channel (mean, biased var, count) in fp32
-    (``syncbn.welford_mean_var``)."""
+    (``syncbn.welford_mean_var``).
+
+    Computed as the one-pass ``E[x^2] - E[x]^2`` pair: both reductions
+    read ``x`` once and XLA fuses them into a single pass (often into
+    the producing conv's epilogue).  The two-pass centered formulation
+    (``x.var()``) re-reads the full activation to square the residuals —
+    measured +7% on the whole RN50 b256 step (round 3).  fp32
+    accumulation over normalized-scale activations keeps the
+    cancellation benign (the same trade cuDNN and flax make); the
+    *cross-device* merge stays Chan's algorithm (:func:`welford_parallel`),
+    which is where single-pass numerics would actually bite (large
+    disjoint populations)."""
     x32 = x.astype(jnp.float32)
     count = 1
     for a in reduce_axes:
         count *= x.shape[a]
     mean = x32.mean(axis=tuple(reduce_axes))
-    var = x32.var(axis=tuple(reduce_axes))  # biased
+    mean_sq = jnp.square(x32).mean(axis=tuple(reduce_axes))
+    var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)  # biased
     return mean, var, count
 
 

@@ -1,0 +1,86 @@
+"""Fused 1x1-conv backward kernel conformance (interpret mode on CPU).
+
+The kernel is opt-in (measured slower in-model on v5e — see the module
+docstring) but its numerics stay pinned: dx must match the lax transpose
+exactly, dW to fp32-accumulation tolerance, and the routing predicate
+must reject everything that is not a 1x1/stride-1/NHWC conv.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from apex_tpu.ops.pallas import conv1x1 as c1
+
+DN = ("NHWC", "HWIO", "NHWC")
+
+
+def _grads(f, x, w, dy):
+    def loss(x, w):
+        return jnp.sum(f(x, w).astype(jnp.float32)
+                       * dy.astype(jnp.float32))
+    return jax.grad(loss, (0, 1))(x, w)
+
+
+@pytest.mark.parametrize("b,s,cin,cout", [(2, 8, 64, 256), (2, 8, 256, 64),
+                                          (1, 16, 128, 128)])
+def test_bwd_matches_lax_transpose(b, s, cin, cout):
+    kx, kw, kd = jax.random.split(jax.random.PRNGKey(cin + cout), 3)
+    x = jax.random.normal(kx, (b, s, s, cin), jnp.float32)
+    w = jax.random.normal(kw, (1, 1, cin, cout), jnp.float32) * 0.05
+    dy = jax.random.normal(kd, (b, s, s, cout), jnp.float32)
+    ref = _grads(lambda x, w: lax.conv_general_dilated(
+        x, w, (1, 1), "SAME", dimension_numbers=DN), x, w, dy)
+    got = _grads(c1.conv1x1, x, w, dy)
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(ref[0]),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got[1]), np.asarray(ref[1]),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_remainder_m_falls_back():
+    """B*H*W not divisible by any tile -> the lax transpose path (still
+    correct, no crash)."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 3, 3, 64), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 64, 64),
+                          jnp.float32) * 0.1
+    dy = jnp.ones((1, 3, 3, 64), jnp.float32)
+    ref = _grads(lambda x, w: lax.conv_general_dilated(
+        x, w, (1, 1), "SAME", dimension_numbers=DN), x, w, dy)
+    got = _grads(c1.conv1x1, x, w, dy)
+    for g, r in zip(got, ref):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_routeable_predicate(monkeypatch):
+    monkeypatch.setenv("APEX_TPU_FUSED_CONV1X1", "1")
+    monkeypatch.setenv("APEX_TPU_KERNELS", "pallas")
+    x = jnp.zeros((2, 8, 8, 64), jnp.bfloat16)
+    w11 = jnp.zeros((1, 1, 64, 128), jnp.bfloat16)
+    ok = lambda **kw: c1.routeable(
+        x, kw.pop("kernel", w11), kw.pop("strides", (1, 1)),
+        kw.pop("padding", "SAME"), kw.pop("dn", DN), kw.pop("extra", {}))
+    assert ok()
+    # lax's None dimension_numbers default is NCHW/OIHW — never routed
+    assert not ok(dn=None)
+    assert not ok(kernel=jnp.zeros((3, 3, 64, 128), jnp.bfloat16))
+    assert not ok(strides=(2, 2))
+    assert not ok(padding=[(1, 1), (0, 0)])
+    assert ok(padding=[(0, 0), (0, 0)])
+    assert not ok(extra={"feature_group_count": 2})
+    assert not ok(kernel=jnp.zeros((1, 1, 64, 128), jnp.float32))  # mixed
+
+    monkeypatch.setenv("APEX_TPU_FUSED_CONV1X1", "0")
+    assert not ok()
+
+
+def test_vmem_tile_budget():
+    """Tile selection caps the VMEM footprint (a 4096 tile at
+    cin 512/cout 256 measured 20.75M > the 16M scoped limit on chip)."""
+    t = c1._pick_tile(200704, 512, 256, 2)
+    assert t is not None
+    assert 2 * 2 * t * (2 * 512 + 256) <= 10 * 1024 * 1024
+    assert c1._pick_tile(7 * 13, 64, 64, 2) is None
