@@ -286,6 +286,23 @@ def attention(
     the ring path's block engine)."""
     if impl not in ("ring", "ulysses", "flash", "jnp"):
         raise ValueError(f"unknown attention impl {impl!r}")
+    layout = kwargs.pop("layout", "blhd")
+    if layout == "bhld":
+        # Head-major fast path (see flash_attention): local only — the
+        # sequence-parallel engines speak (B, L, H, D).
+        if axis_name is not None:
+            raise ValueError("layout='bhld' requires axis_name=None")
+        if impl == "flash" or (impl != "jnp" and _use_pallas_blocks()):
+            from apex_tpu.ops.pallas.flash_attention import flash_attention
+            return flash_attention(q, k, v, layout="bhld",
+                                   causal=kwargs.get("causal", False),
+                                   kv_mask=kwargs.get("kv_mask"),
+                                   scale=kwargs.get("scale"))
+        # jnp path (impl="jnp" or the kernel gate off): speak (B,L,H,D)
+        out = attention(jnp.moveaxis(q, 1, 2), jnp.moveaxis(k, 1, 2),
+                        jnp.moveaxis(v, 1, 2), axis_name=None, impl=impl,
+                        **kwargs)
+        return jnp.moveaxis(out, 1, 2)
     if axis_name is None:
         if impl == "flash" or (impl != "jnp" and _use_pallas_blocks()):
             from apex_tpu.ops.pallas.flash_attention import flash_attention

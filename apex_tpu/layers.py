@@ -98,3 +98,51 @@ class Dense(nn.Module):
                            (self.features,), self.param_dtype)
                 if self.use_bias else None)
         return amp_ops.linear(x, kernel, bias)
+
+
+class HeadMajorQKVProj(nn.Module):
+    """Fused qkv projection emitting head-major ``(3, B, H, L, D)``.
+
+    Parameter shapes/init/paths are identical to ``Dense(3*E)`` (kernel
+    ``(E, 3E)``, bias ``(3E,)``) so checkpoints and the non-fast path
+    interchange freely; the head-major output permutation lives INSIDE
+    the einsum, where the dot emits it for free — the reshape+transpose
+    spelling after a plain Dense materialized as explicit copies
+    (round-3 profile)."""
+
+    hidden_size: int
+    num_heads: int
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        e, h = self.hidden_size, self.num_heads
+        d = e // h
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (e, 3 * e), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, (3 * e,),
+                          self.param_dtype)
+        qkv = amp_ops.einsum("ble,eihd->ibhld", x,
+                             kernel.reshape(e, 3, h, d))
+        return qkv + bias.reshape(3, 1, h, 1, d).astype(qkv.dtype)
+
+
+class HeadMajorOutProj(nn.Module):
+    """Output projection reading head-major ``(B, H, L, D)`` directly
+    (``Dense(E)``-compatible params; the input permutation folds into
+    the dot)."""
+
+    hidden_size: int
+    num_heads: int
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, o):
+        e, h = self.hidden_size, self.num_heads
+        d = e // h
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (e, e), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, (e,),
+                          self.param_dtype)
+        y = amp_ops.einsum("bhld,hde->ble", o, kernel.reshape(h, d, e))
+        return y + bias.astype(y.dtype)

@@ -86,6 +86,24 @@ class SelfAttention(nn.Module):
     def __call__(self, x, mask=None):
         c = self.cfg
         head_dim = c.hidden_size // c.num_heads
+        from apex_tpu.ops import use_pallas
+        if use_pallas():
+            # Head-major fast path: projections emit/consume
+            # (B, H, L, D) with the permutation inside their dots, and
+            # the flash kernel runs layout="bhld" — no (B*H, L, D)
+            # relayout copies (see models/gpt.py; BERT has no rotary
+            # step in between, so the path is pure).
+            from apex_tpu.layers import HeadMajorOutProj, HeadMajorQKVProj
+            from apex_tpu.ops.pallas.flash_attention import flash_attention
+            qkv = HeadMajorQKVProj(c.hidden_size, c.num_heads,
+                                   name="qkv")(x)
+            kv_mask = None if mask is None else mask.astype(bool)
+            out = flash_attention(qkv[0], qkv[1], qkv[2], kv_mask=kv_mask,
+                                  scale=1.0 / float(head_dim) ** 0.5,
+                                  layout="bhld")
+            return HeadMajorOutProj(c.hidden_size, c.num_heads,
+                                    name="out")(out)
+
         qkv = Dense(3 * c.hidden_size, name="qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
@@ -93,28 +111,19 @@ class SelfAttention(nn.Module):
             return t.reshape(t.shape[0], t.shape[1], c.num_heads, head_dim)
 
         q, k, v = heads(q), heads(k), heads(v)
-        from apex_tpu.ops import use_pallas
-        if use_pallas():
-            # Fused blockwise attention — the (L, L) score matrix never
-            # hits HBM (apex_tpu.ops.pallas.flash_attention).
-            from apex_tpu.ops.pallas.flash_attention import flash_attention
-            kv_mask = None if mask is None else mask.astype(bool)
-            out = flash_attention(q, k, v, kv_mask=kv_mask,
-                                  scale=1.0 / float(head_dim) ** 0.5)
-        else:
-            scores = amp_ops.einsum("bqhd,bkhd->bhqk", q, k) \
-                / jnp.sqrt(head_dim)
-            if mask is not None:
-                # mask: (B, L) 1 = attend; large negative in fp32
-                bias = (1.0 - mask[:, None, None, :]
-                        .astype(jnp.float32)) * -1e9
-                scores = scores.astype(jnp.float32) + bias
-            probs = amp_ops.softmax(scores, axis=-1).astype(v.dtype)
-            if mask is not None:
-                # all-padding rows emit zeros, matching the flash branch
-                probs = jnp.where(mask[:, None, None, :].astype(bool),
-                                  probs, 0)
-            out = amp_ops.einsum("bhqk,bkhd->bqhd", probs, v)
+        scores = amp_ops.einsum("bqhd,bkhd->bhqk", q, k) \
+            / jnp.sqrt(head_dim)
+        if mask is not None:
+            # mask: (B, L) 1 = attend; large negative in fp32
+            bias = (1.0 - mask[:, None, None, :]
+                    .astype(jnp.float32)) * -1e9
+            scores = scores.astype(jnp.float32) + bias
+        probs = amp_ops.softmax(scores, axis=-1).astype(v.dtype)
+        if mask is not None:
+            # all-padding rows emit zeros, matching the flash branch
+            probs = jnp.where(mask[:, None, None, :].astype(bool),
+                              probs, 0)
+        out = amp_ops.einsum("bhqk,bkhd->bqhd", probs, v)
         out = out.reshape(x.shape[0], x.shape[1], c.hidden_size)
         return Dense(c.hidden_size, name="out")(out)
 

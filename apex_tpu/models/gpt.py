@@ -96,6 +96,36 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def _rope_rot_matrix(d: int) -> jax.Array:
+    """Constant (D, D) matrix with ``x @ R == rotate_half(x)`` (i.e.
+    ``concat(-x2, x1)``).  Entries are 0/±1, exact in bf16."""
+    half = d // 2
+    i = jnp.arange(half)
+    r = jnp.zeros((d, d), jnp.float32)
+    r = r.at[half + i, i].set(-1.0)
+    r = r.at[i, half + i].set(1.0)
+    return r
+
+
+def apply_rope_mxu(x: jax.Array, cos_full: jax.Array,
+                   sin_full: jax.Array) -> jax.Array:
+    """Rotary embedding with the half-rotation as an MXU matmul.
+
+    The concat-of-half-slices spelling (:func:`apply_rope`) creates
+    minor-dim-32 lane slices whose fwd+bwd materialize as copies in the
+    head-major layout (round-3 profile: 48 copies + fp32 backward
+    copies per step).  ``x @ R`` with a constant 0/±1 matrix is the
+    same permutation on the MXU — layout-neutral, exact, and its
+    transpose is again a single matmul.  Tables are full-width:
+    ``cos_full = concat(cos, cos)``, ``sin_full = concat(sin, sin)``.
+    """
+    r = _rope_rot_matrix(x.shape[-1]).astype(x.dtype)
+    xr = x @ r
+    out = (x.astype(jnp.float32) * cos_full
+           + xr.astype(jnp.float32) * sin_full)
+    return out.astype(x.dtype)
+
+
 def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     """One-shot rotary embedding (tables + apply); positions are global
     indices, so a sequence-sharded rank rotates its local shard
@@ -111,23 +141,30 @@ class CausalSelfAttention(nn.Module):
     def __call__(self, x, rope_cs):
         c = self.cfg
         head_dim = c.hidden_size // c.num_heads
+        b, l = x.shape[0], x.shape[1]
+        cos, sin = rope_cs
+        scale = 1.0 / float(head_dim) ** 0.5
+        from apex_tpu.attention import attention
+        # NB: the head-major fast path (_QKVProj + layout="bhld" +
+        # apply_rope_mxu — see models/bert.py, +3% there) measured a
+        # net -3% HERE: without rope the path saves the relayout
+        # copies, but GPT's rotary step between projection and kernel
+        # re-materializes head-major intermediates that the split
+        # spelling hides inside its (already-paid) relayouts.  The
+        # split path stays until someone fuses rope into the kernel.
         qkv = Dense(3 * c.hidden_size, name="qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(t):
             return t.reshape(t.shape[0], t.shape[1], c.num_heads, head_dim)
 
-        cos, sin = rope_cs
         q = apply_rope(heads(q), cos, sin)
         k = apply_rope(heads(k), cos, sin)
         v = heads(v)
-        scale = 1.0 / float(head_dim) ** 0.5
-        from apex_tpu.attention import attention
-        # local: the Pallas flash kernel (jnp path off-TPU); with
-        # seq_axis_name: ring attention over the mesh axis
+        # with seq_axis_name: ring attention over the mesh axis
         out = attention(q, k, v, axis_name=c.seq_axis_name, causal=True,
                         scale=scale)
-        out = out.reshape(x.shape[0], x.shape[1], c.hidden_size)
+        out = out.reshape(b, l, c.hidden_size)
         return Dense(c.hidden_size, name="out")(out)
 
 

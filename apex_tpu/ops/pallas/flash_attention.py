@@ -408,30 +408,42 @@ def _fused_bwd_max_bytes() -> int:
     return 1 << 30
 
 
-def _pad_bhld(t, lp):
-    """(B, L, H, D) → (BH, Lp, D) with zero sequence padding."""
-    b, l, h, d = t.shape
-    t = jnp.moveaxis(t, 2, 1).reshape(b * h, l, d)
+def _pad_bhld(t, lp, layout="blhd"):
+    """(B, L, H, D) or (B, H, L, D) → (BH, Lp, D), zero sequence padding.
+
+    The ``bhld`` layout is the transpose-free fast path: models that
+    emit per-head-major q/k/v (the projection dot absorbs the transpose
+    for free — measured round 3) reach the kernel with a pure reshape,
+    skipping the materialized relayout the ``blhd`` view needs (~6
+    copies of (B, L, E) per transformer layer, fwd+bwd)."""
+    if layout == "bhld":
+        b, h, l, d = t.shape
+        t = t.reshape(b * h, l, d)
+    else:
+        b, l, h, d = t.shape
+        t = jnp.moveaxis(t, 2, 1).reshape(b * h, l, d)
     if lp != l:
         t = jnp.pad(t, ((0, 0), (0, lp - l), (0, 0)))
     return t
 
 
-def _prep(q, k, v, bias, block_q, block_k):
-    """(B, L, H, D) → padded (BH, Lp, D); pad the additive key bias with
-    ``NEG_INF`` so padded keys never attend."""
-    l = q.shape[1]
+def _prep(q, k, v, bias, block_q, block_k, layout="blhd"):
+    """q/k/v (see ``_pad_bhld``) → padded (BH, Lp, D); pad the additive
+    key bias with ``NEG_INF`` so padded keys never attend."""
+    l = q.shape[2] if layout == "bhld" else q.shape[1]
     lp = _ceil_to(l, math.lcm(block_q, block_k))
     if bias is not None:
         if lp != l:
             bias = jnp.pad(bias, ((0, 0), (0, lp - l)),
                            constant_values=NEG_INF)
         bias = bias[:, None, :]        # (B, 1, Lp): Mosaic-legal row blocks
-    return _pad_bhld(q, lp), _pad_bhld(k, lp), _pad_bhld(v, lp), bias, lp
+    return (_pad_bhld(q, lp, layout), _pad_bhld(k, lp, layout),
+            _pad_bhld(v, lp, layout), bias, lp)
 
 
-def _unprep(t, b, l, h, d):
-    return jnp.moveaxis(t.reshape(b, h, -1, d)[:, :, :l, :], 1, 2)
+def _unprep(t, b, l, h, d, layout="blhd"):
+    t = t.reshape(b, h, -1, d)[:, :, :l, :]
+    return t if layout == "bhld" else jnp.moveaxis(t, 1, 2)
 
 
 @functools.partial(jax.jit,
@@ -542,10 +554,11 @@ def _flash_bwd(qf, kf, vf, of, do_f, lse, bias, dlse_f, *, causal,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, bias, scale, causal, block_q, block_k, has_bias):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, bias, scale, causal, block_q, block_k, has_bias,
+           layout):
     (out, lse_pub), _ = _flash_core(q, k, v, bias, scale, causal,
-                                    block_q, block_k, has_bias)
+                                    block_q, block_k, has_bias, layout)
     return out, lse_pub
 
 
@@ -554,32 +567,40 @@ def _lse_public(lse, b, l, h):
     return jnp.moveaxis(lse[:, :, 0].reshape(b, h, -1)[:, :, :l], 1, 2)
 
 
-def _flash_core(q, k, v, bias, scale, causal, block_q, block_k, has_bias):
-    b, l, h, d = q.shape
-    qf, kf, vf, bias_p, lp = _prep(q, k, v, bias, block_q, block_k)
+def _flash_core(q, k, v, bias, scale, causal, block_q, block_k, has_bias,
+                layout="blhd"):
+    if layout == "bhld":
+        b, h, l, d = q.shape
+    else:
+        b, l, h, d = q.shape
+    qf, kf, vf, bias_p, lp = _prep(q, k, v, bias, block_q, block_k, layout)
     # Softmax scale folded into q once ((L, d) elementwise, fused into
     # the prep reshuffle) instead of an (L, L) pass per score block.
     qf = qf * jnp.asarray(scale, qf.dtype)
     of, lse = _flash_fwd(qf, kf, vf, bias_p, causal=causal,
                          has_bias=has_bias, block_q=block_q,
                          block_k=block_k, num_heads=h)
-    return ((_unprep(of, b, l, h, d), _lse_public(lse, b, l, h)),
+    return ((_unprep(of, b, l, h, d, layout), _lse_public(lse, b, l, h)),
             (qf, kf, vf, of, lse, bias_p))
 
 
 def _flash_fwd_rule(q, k, v, bias, scale, causal, block_q, block_k,
-                    has_bias):
+                    has_bias, layout):
     outs, res = _flash_core(q, k, v, bias, scale, causal, block_q,
-                            block_k, has_bias)
+                            block_k, has_bias, layout)
     return outs, (res, q.shape)
 
 
-def _flash_bwd_rule(scale, causal, block_q, block_k, has_bias, saved,
-                    cotangents):
+def _flash_bwd_rule(scale, causal, block_q, block_k, has_bias, layout,
+                    saved, cotangents):
     dout, dlse = cotangents
-    (qf, kf, vf, of, lse, bias_p), (b, l, h, d) = saved
+    (qf, kf, vf, of, lse, bias_p), shape = saved
+    if layout == "bhld":
+        b, h, l, d = shape
+    else:
+        b, l, h, d = shape
     lp = qf.shape[1]
-    do_f = _pad_bhld(dout, lp)
+    do_f = _pad_bhld(dout, lp, layout)
     # A cotangent on the logsumexp folds into the backward as an offset on
     # delta: ds_ij = p_ij (dp_ij - delta_i + dlse_i), since dlse_i/ds_ij =
     # p_ij.  Zero-cotangent callers (plain attention) pay nothing.
@@ -594,9 +615,9 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, has_bias, saved,
                         block_q=block_q, block_k=block_k, num_heads=h)
     # The kernels differentiate w.r.t. the pre-scaled q: dk comes out
     # exact (ds^T @ q_scaled), dq needs the one deferred scale.
-    dq = _unprep(dqf, b, l, h, d) * jnp.asarray(scale, dqf.dtype)
-    dk = _unprep(dkf, b, l, h, d)
-    dv = _unprep(dvf, b, l, h, d)
+    dq = _unprep(dqf, b, l, h, d, layout) * jnp.asarray(scale, dqf.dtype)
+    dk = _unprep(dkf, b, l, h, d, layout)
+    dv = _unprep(dvf, b, l, h, d, layout)
     return dq, dk, dv, jnp.zeros((b, l), jnp.float32)
 
 
@@ -667,8 +688,15 @@ def _varying(x) -> bool:
 
 
 def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
-                    block_q=None, block_k=None, return_lse=False):
+                    block_q=None, block_k=None, return_lse=False,
+                    layout="blhd"):
     """Blockwise exact attention, ``(B, L, H, D)`` convention.
+
+    ``layout="bhld"`` instead takes/returns ``(B, H, L, D)`` — the
+    transpose-free fast path for models whose projections emit
+    head-major tensors (the relayout to the kernel's row view becomes a
+    pure reshape; output and gradients likewise).  The logsumexp stays
+    ``(B, L, H)`` in either layout.
 
     Equivalent to the jnp reference path in :mod:`apex_tpu.attention`
     (scores never materialized; fp32 softmax; masked rows emit zeros).
@@ -687,20 +715,27 @@ def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
     fp32 (``NEG_INF`` for fully-masked rows) — differentiable, so partial
     results can be merged online (ring attention's carry).
     """
+    if layout not in ("blhd", "bhld"):
+        raise ValueError(f"unknown layout {layout!r}")
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    b, l = q.shape[0], q.shape[1]
-    if k.shape[1] != l:
-        if return_lse:
+    seq_ax = 2 if layout == "bhld" else 1
+    b, l = q.shape[0], q.shape[seq_ax]
+    if k.shape[seq_ax] != l or (not on_tpu() and _varying(q)):
+        # Cross-attention (blockwise packing needs one shared length) and
+        # interpret-mode-under-shard_map (a VMA propagation limitation in
+        # jax's pallas interpreter; compiled Mosaic is unaffected) route
+        # to the equivalent jnp math, which speaks (B, L, H, D).
+        if k.shape[seq_ax] != l and return_lse:
             raise ValueError("return_lse requires Lq == Lk (kernel path)")
-        return _jnp_attention(q, k, v, causal=causal, kv_mask=kv_mask,
-                              scale=float(scale))
-    if not on_tpu() and _varying(q):
-        # Interpret-mode pallas under shard_map trips a VMA propagation
-        # limitation in jax's interpreter (dynamic_slice with mixed manual
-        # axes); compiled Mosaic is unaffected.  Use the equivalent jnp
-        # math so CPU-mesh tests of ring/ulysses still exercise the
-        # merge algebra.
+        if layout == "bhld":
+            out = _jnp_attention(
+                jnp.moveaxis(q, 1, 2), jnp.moveaxis(k, 1, 2),
+                jnp.moveaxis(v, 1, 2), causal=causal, kv_mask=kv_mask,
+                scale=float(scale), return_lse=return_lse)
+            if return_lse:
+                return jnp.moveaxis(out[0], 1, 2), out[1]
+            return jnp.moveaxis(out, 1, 2)
         return _jnp_attention(q, k, v, causal=causal, kv_mask=kv_mask,
                               scale=float(scale), return_lse=return_lse)
     explicit = (block_q, block_k)
@@ -736,5 +771,5 @@ def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
     padded = l % math.lcm(int(block_q), int(block_k)) != 0
     has_bias = kv_mask is not None or (padded and not causal)
     out, lse = _flash(q, k, v, bias, float(scale), bool(causal),
-                      int(block_q), int(block_k), has_bias)
+                      int(block_q), int(block_k), has_bias, layout)
     return (out, lse) if return_lse else out
