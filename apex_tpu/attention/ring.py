@@ -287,29 +287,23 @@ def attention(
     if impl not in ("ring", "ulysses", "flash", "jnp"):
         raise ValueError(f"unknown attention impl {impl!r}")
     layout = kwargs.pop("layout", "blhd")
-    if layout == "bhld":
+    if layout == "bhld" and axis_name is not None:
         # Head-major fast path (see flash_attention): local only — the
         # sequence-parallel engines speak (B, L, H, D).
-        if axis_name is not None:
-            raise ValueError("layout='bhld' requires axis_name=None")
-        if impl == "flash" or (impl != "jnp" and _use_pallas_blocks()):
-            from apex_tpu.ops.pallas.flash_attention import flash_attention
-            return flash_attention(q, k, v, layout="bhld",
-                                   causal=kwargs.get("causal", False),
-                                   kv_mask=kwargs.get("kv_mask"),
-                                   scale=kwargs.get("scale"))
-        # jnp path (impl="jnp" or the kernel gate off): speak (B,L,H,D)
-        out = attention(jnp.moveaxis(q, 1, 2), jnp.moveaxis(k, 1, 2),
-                        jnp.moveaxis(v, 1, 2), axis_name=None, impl=impl,
-                        **kwargs)
-        return jnp.moveaxis(out, 1, 2)
+        raise ValueError("layout='bhld' requires axis_name=None")
     if axis_name is None:
         if impl == "flash" or (impl != "jnp" and _use_pallas_blocks()):
             from apex_tpu.ops.pallas.flash_attention import flash_attention
-            return flash_attention(q, k, v,
+            return flash_attention(q, k, v, layout=layout,
                                    causal=kwargs.get("causal", False),
                                    kv_mask=kwargs.get("kv_mask"),
                                    scale=kwargs.get("scale"))
+        if layout == "bhld":
+            # jnp fallback speaks (B, L, H, D)
+            out = attention(jnp.moveaxis(q, 1, 2), jnp.moveaxis(k, 1, 2),
+                            jnp.moveaxis(v, 1, 2), axis_name=None,
+                            impl=impl, **kwargs)
+            return jnp.moveaxis(out, 1, 2)
         s = _block_scores(q, k, kwargs.get("scale") or 1.0 / (q.shape[-1] ** 0.5),
                           0, 0, kwargs.get("causal", False),
                           kwargs.get("kv_mask"))

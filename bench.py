@@ -155,18 +155,49 @@ def bench_gpt(batch: int, seq: int, warmup: int, iters: int, peak: float,
     dt = _time_steps(compiled, state, (ids,), warmup, iters)
 
     return _lm_result(compiled, cfg, params, batch, seq, dt, iters, peak,
-                      "tok_s", batch * seq * iters / dt)
+                      "tok_s", batch * seq * iters / dt, causal=True,
+                      remat=remat)
+
+
+def flash_attention_step_flops(cfg, batch: int, seq: int,
+                               causal: bool, remat: bool = False) -> float:
+    """Analytic fwd+bwd FLOPs of the Pallas attention calls in one step.
+
+    XLA's cost analysis reports (near-)ZERO flops for custom calls
+    (measured: 0.003 GF vs 12.9 GF analytic for one L2048 forward), so
+    without this term every transformer MFU undercounts by the
+    attention fraction — ~1% at L2048 but ~40% at L8192, where the
+    round-2 numbers made long context look like an efficiency collapse
+    that was mostly an accounting artifact.
+
+    Counted as executed matmul passes of ``2*B*H*L^2*D`` flops each:
+    forward 2 (QK^T, PV), fused backward 5 (s recompute, dp, dv, dk,
+    dq).  A remat'd layer body would re-run the forward's 2, but
+    remat=True measures identical step time to remat=False here (XLA
+    CSEs the recompute), so no remat term is counted — conservative if
+    a future config genuinely recomputes.  Causal halves every pass
+    (the kernels skip dead blocks)."""
+    del remat
+    head_dim = cfg.hidden_size // cfg.num_heads
+    one_pass = 2.0 * batch * cfg.num_heads * float(seq) ** 2 * head_dim
+    return cfg.num_layers * 7 * one_pass * (0.5 if causal else 1.0)
 
 
 def _lm_result(compiled, cfg, params, batch, seq, dt, iters, peak,
-               rate_key, rate):
+               rate_key, rate, causal=True, remat=False):
     """Shared tail for the transformer benches: params count, FLOPs with
     the 6ND + attention analytic fallback, MFU."""
     n_params = sum(int(p.size) for p in jax.tree.leaves(params))
-    flops = step_flops(
-        compiled,
-        fallback=(6.0 * n_params + 12.0 * cfg.num_layers * cfg.hidden_size
-                  * seq) * batch * seq)
+    # step_flops covers everything XLA sees; the pallas attention calls
+    # report ~0 there and are added analytically.  When attention runs
+    # as plain einsums instead (off-TPU / APEX_TPU_KERNELS=jnp), cost
+    # analysis already counts it — adding the term then would double
+    # count in the other direction.
+    from apex_tpu.ops import use_pallas
+    attn = (flash_attention_step_flops(cfg, batch, seq, causal, remat)
+            if use_pallas() else 0.0)
+    flops = step_flops(compiled, fallback=6.0 * n_params * batch * seq) \
+        + attn
     mfu = round(flops * iters / dt / peak, 4) if peak else None
     return {rate_key: round(rate, 2), "mfu": mfu,
             "batch": batch, "seq": seq, "params": n_params}
@@ -251,7 +282,7 @@ def bench_bert(batch: int, seq: int, warmup: int, iters: int, peak: float,
     dt = _time_steps(compiled, state, args, warmup, iters)
 
     return _lm_result(compiled, cfg, params, batch, seq, dt, iters, peak,
-                      "seq_s", batch * iters / dt)
+                      "seq_s", batch * iters / dt, causal=False)
 
 
 def main():
@@ -284,10 +315,18 @@ def main():
     except ValueError:  # malformed env must not cost the round's artifact
         optional_budget_s = 900.0
 
-    def record(name, fn, optional=False, **kw):
+    def record(name, fn, optional=False, fresh=False, **kw):
         if optional and time.perf_counter() - t_start > optional_budget_s:
             configs[name] = {"skipped": "bench time budget"}
             return
+        if fresh:
+            # drop cached executables + their donated buffers first: HBM
+            # fragmentation from earlier configs tanks very-long-context
+            # allocations (round-2: L16384 measured 3x slower after an
+            # L8192 model in the same process)
+            import gc
+            jax.clear_caches()
+            gc.collect()
         # one in-place retry first: the tunneled device occasionally drops
         # an attempt that succeeds immediately on rerun; only a SECOND
         # failure (e.g. a genuine OOM) is recorded as this config's error,
@@ -327,6 +366,13 @@ def main():
         # TPU-native input stem (space-to-depth, +8% over conv7+maxpool)
         record("resnet50_s2d_o2", bench_resnet, optional=True,
                opt_level="O2", s2d=True, **rn_args)
+        # 16K context, LAST + fresh: the fused one-pass attention
+        # backward still runs (805 MB dq partials, under the 1 GiB
+        # budget), and clearing caches avoids the HBM-fragmentation
+        # slowdown of back-to-back long-context models in one process
+        record("gpt_small_tpu_heads_L16384_o2", bench_gpt, optional=True,
+               fresh=True, tpu_heads=True, remat=True, batch=1,
+               seq=16384, warmup=2, iters=8, tiny=False)
 
     # Headline = the parity configs only (the conv7-stem model the
     # BASELINE derivation refers to); the s2d variant stays a
