@@ -195,7 +195,10 @@ def fused_adam(learning_rate=1e-3, beta1: float = 0.9, beta2: float = 0.999,
         # packing pays a pack/unpack HBM round-trip every step — keep the
         # persistent-flat representation (FP16Optimizer) for steady-state
         # packing and this path for when profiling shows the fusion count
-        # itself is the bottleneck.
+        # itself is the bottleneck.  Round-3 A/B on one v5e chip settled
+        # the default: packed is 13% SLOWER end-to-end on RN50 b256
+        # (161 conv-scale leaves to gather/scatter) and -0.8% on
+        # GPT-small b8/L2048 — per-leaf stays.
         import os
         if (os.environ.get("APEX_TPU_ADAM_PACKED") == "1" and use_pallas()
                 and ps and _tree_within_capacity(ps)):

@@ -192,12 +192,15 @@ def _lm_result(compiled, cfg, params, batch, seq, dt, iters, peak,
     # report ~0 there and are added analytically.  When attention runs
     # as plain einsums instead (off-TPU / APEX_TPU_KERNELS=jnp), cost
     # analysis already counts it — adding the term then would double
-    # count in the other direction.
+    # count — but the analytic FALLBACK (cost analysis unavailable)
+    # must still include it on that path.
     from apex_tpu.ops import use_pallas
-    attn = (flash_attention_step_flops(cfg, batch, seq, causal, remat)
-            if use_pallas() else 0.0)
-    flops = step_flops(compiled, fallback=6.0 * n_params * batch * seq) \
-        + attn
+    attn = flash_attention_step_flops(cfg, batch, seq, causal, remat)
+    dense_fb = 6.0 * n_params * batch * seq
+    if use_pallas():
+        flops = step_flops(compiled, fallback=dense_fb) + attn
+    else:
+        flops = step_flops(compiled, fallback=dense_fb + attn)
     mfu = round(flops * iters / dt / peak, 4) if peak else None
     return {rate_key: round(rate, 2), "mfu": mfu,
             "batch": batch, "seq": seq, "params": n_params}

@@ -1,0 +1,319 @@
+"""Convergence milestones for the transformer + GAN workloads (round 3).
+
+The reference's north star is a *convergence* number, not a throughput
+one; round 2 proved the imagenet path (digits 98.6% top-1 on chip) but
+left GPT/BERT/DCGAN as throughput-only configs.  This tool runs three
+zero-egress proofs on the real chip and writes ``CONVERGENCE_r03.json``
+with machine-readable targets:
+
+1. ``gpt_pysrc``   — byte-level causal LM over the Python stdlib sources
+   (the ``examples/gpt_lm.py --data pysrc`` corpus) with a held-out
+   tail; target: validation loss (nats/byte) under the bar.
+2. ``bert_mlm``    — byte-level masked-LM over the same corpus (15%
+   masking); target: masked-position CE under the bar (vs ln(vocab) =
+   5.6 at chance).
+3. ``dcgan_two_scaler`` — the two-optimizer/two-scaler GAN loop run in
+   fp16 compute, where dynamic-range overflows actually happen: the
+   proof is overflow events OBSERVED and RECOVERED (scales halved, the
+   run continues, final losses finite) — the reference's ``num_losses``
+   machinery under real dynamics (``apex/amp/handle.py:53-58``).
+
+Scales are parameterized so the l1 slow tier can run miniatures on CPU
+(``tests/l1/test_convergence_targets.py``); the defaults are the
+on-chip proof.  Usage: ``python tools/convergence_run.py [out.json]``.
+"""
+
+import dataclasses
+import json
+import sys
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "examples"))
+
+
+def _corpus():
+    from gpt_lm import _load_pysrc_corpus
+    return _load_pysrc_corpus()
+
+
+def _windows(corpus, rng, batch, seq, lo, hi):
+    starts = rng.randint(lo, hi - seq - 1, size=batch)
+    return jnp.asarray(
+        np.stack([corpus[s:s + seq] for s in starts]).astype(np.int32))
+
+
+def run_gpt_pysrc(steps=600, batch=16, seq=512, hidden=256, layers=4,
+                  heads=4, lr=3e-4, target_val_nats=1.75, seed=0,
+                  corpus=None):
+    """Byte-level GPT on pysrc; returns the record with val nats/byte."""
+    from apex_tpu import amp
+    from apex_tpu.models.gpt import GPTConfig, GPTModel, lm_loss
+    from apex_tpu.optimizers import FusedAdam
+
+    corpus = _corpus() if corpus is None else corpus
+    split = int(len(corpus) * 0.9)
+    rng = np.random.RandomState(seed)
+    cfg = GPTConfig(vocab_size=256, hidden_size=hidden, num_layers=layers,
+                    num_heads=heads, intermediate_size=4 * hidden)
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    a = amp.initialize(optimizer=FusedAdam(lr=lr), opt_level="O2",
+                       verbosity=0)
+    state = a.init(params)
+
+    def loss_fn(p, ids):
+        logits = model.apply({"params": p}, ids)
+        return lm_loss(logits[:, :-1], ids[:, 1:])
+
+    step = jax.jit(amp.make_train_step(a, loss_fn))
+    eval_loss = jax.jit(lambda p, ids: loss_fn(p, ids))
+
+    t0 = time.perf_counter()
+    train_loss = None
+    for i in range(steps):
+        ids = _windows(corpus, rng, batch, seq, 0, split)
+        state, m = step(state, ids)
+        train_loss = float(m["loss"])
+    # fixed held-out windows from the tail 10% the model never saw
+    vrng = np.random.RandomState(10_000 + seed)
+    val = float(np.mean([
+        float(eval_loss(a.model_params(state),
+                        _windows(corpus, vrng, batch, seq, split,
+                                 len(corpus))))
+        for _ in range(8)]))
+    return {"name": "gpt_pysrc", "steps": steps, "batch": batch,
+            "seq": seq, "hidden": hidden, "layers": layers,
+            "train_nats": round(train_loss, 4),
+            "val_nats_per_byte": round(val, 4),
+            "val_bits_per_byte": round(val / float(np.log(2)), 4),
+            "target_val_nats": target_val_nats,
+            "wall_s": round(time.perf_counter() - t0, 1),
+            "ok": bool(val <= target_val_nats)}
+
+
+def run_bert_mlm(steps=600, batch=16, seq=256, hidden=256, layers=4,
+                 heads=4, lr=3e-4, target_mlm_nats=3.25, seed=0,
+                 corpus=None):
+    """Byte-level BERT MLM on pysrc (mask id 256, 15% positions).
+
+    Target derivation: chance is ln(257) = 5.55 nats; the 4-layer
+    miniature converges to ~3.07 val nats on chip (train 2.87 — the
+    ~0.2 gap is this model's capacity/overfit limit on the 7 MB
+    corpus), so the bar sits at 3.25 = ~41% below chance with ~6%
+    regression headroom — a drift alarm, not a leaderboard."""
+    from apex_tpu import amp
+    from apex_tpu.models.bert import BertConfig, BertForPreTraining
+    from apex_tpu.optimizers import FusedLAMB
+
+    corpus = _corpus() if corpus is None else corpus
+    split = int(len(corpus) * 0.9)
+    rng = np.random.RandomState(seed)
+    cfg = BertConfig(vocab_size=257, hidden_size=hidden, num_layers=layers,
+                     num_heads=heads, intermediate_size=4 * hidden,
+                     max_position_embeddings=seq)
+    model = BertForPreTraining(cfg)
+    MASK = 256
+
+    def make_batch(lo, hi, r):
+        ids = _windows(corpus, r, batch, seq, lo, hi)
+        pos = jnp.asarray(r.rand(batch, seq) < 0.15)
+        return jnp.where(pos, MASK, ids), ids, pos.astype(jnp.float32)
+
+    x0, _, _ = make_batch(0, split, rng)
+    params = model.init(jax.random.PRNGKey(seed), x0)["params"]
+    a = amp.initialize(optimizer=FusedLAMB(lr=lr), opt_level="O2",
+                       verbosity=0)
+    state = a.init(params)
+
+    def loss_fn(p, masked, labels, mpos):
+        mlm, _nsp = model.apply({"params": p}, masked)
+        logp = jax.nn.log_softmax(mlm.astype(jnp.float32))
+        ce = -jnp.take_along_axis(logp, labels[..., None], -1)[..., 0]
+        return jnp.sum(ce * mpos) / jnp.maximum(jnp.sum(mpos), 1.0)
+
+    step = jax.jit(amp.make_train_step(a, loss_fn))
+    eval_loss = jax.jit(lambda p, *b: loss_fn(p, *b))
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, m = step(state, *make_batch(0, split, rng))
+    vrng = np.random.RandomState(20_000 + seed)
+    val = float(np.mean([
+        float(eval_loss(a.model_params(state),
+                        *make_batch(split, len(corpus), vrng)))
+        for _ in range(8)]))
+    return {"name": "bert_mlm", "steps": steps, "batch": batch,
+            "seq": seq, "hidden": hidden, "layers": layers,
+            "train_nats": round(float(m["loss"]), 4),
+            "val_mlm_nats": round(val, 4),
+            "chance_nats": round(float(np.log(257)), 3),
+            "target_mlm_nats": target_mlm_nats,
+            "wall_s": round(time.perf_counter() - t0, 1),
+            "ok": bool(val <= target_mlm_nats)}
+
+
+def run_dcgan_two_scaler(steps=300, batch=32, image_size=32, zdim=64,
+                         lr=2e-4, seed=0, half_dtype="float16",
+                         inject=()):
+    """Two-scaler DCGAN: overflows must be observed AND recovered.
+
+    Two modes:
+    - ``half_dtype="float16"`` (CPU slow tier): fp16's 65504 max makes
+      the 2^16 initial scale genuinely overflow on early GAN gradients
+      (observed ~step 19 on CPU) — the organic demonstration.  On the
+      TPU backend fp16 numerics corrupt the run itself (non-native
+      dtype; params NaN within ~50 steps even with every bad step
+      skipped), so the chip record instead uses
+    - ``half_dtype="bfloat16"`` + ``inject=(a, b)``: real GAN dynamics
+      with an inf planted in the REAL batch at step ``a`` (must trip
+      ONLY D's scaler — G's loss never sees the real batch, proving
+      per-loss scaler independence, the ``num_losses`` point) and in
+      ``z`` at step ``b`` (feeds both nets; both scalers trip).
+
+    Recovery = after the last overflow the run keeps training with
+    finite losses and halved-but-stable scales.
+    """
+    import optax
+
+    from apex_tpu import amp
+    from apex_tpu.models.dcgan import Discriminator, Generator, gan_losses
+
+    half = jnp.bfloat16 if half_dtype == "bfloat16" else jnp.float16
+
+    n_up = {32: 2, 64: 3}[image_size]
+    G = Generator(feature_maps=32, n_upsample=n_up)
+    D = Discriminator(feature_maps=32, n_down=n_up + 1)
+    z0 = jax.random.normal(jax.random.PRNGKey(seed), (2, zdim))
+    img0 = jnp.zeros((2, image_size, image_size, 3))
+    gv = G.init(jax.random.PRNGKey(seed + 1), z0, train=True)
+    dv = D.init(jax.random.PRNGKey(seed + 2), img0, train=True)
+
+    adam = lambda: optax.adam(lr, b1=0.5, b2=0.999)
+    a_g = amp.initialize(optimizer=adam(), opt_level="O2",
+                         half_dtype=half, verbosity=0)
+    a_d = amp.initialize(optimizer=adam(), opt_level="O2",
+                         half_dtype=half, verbosity=0)
+    gs, ds = a_g.init(gv["params"]), a_d.init(dv["params"])
+    g_stats, d_stats = gv["batch_stats"], dv["batch_stats"]
+
+    def make_d_loss(g_stats, d_stats):
+        def d_loss(dp, gp, z, real):
+            fake = G.apply({"params": gp, "batch_stats": g_stats}, z,
+                           train=True, mutable=["batch_stats"])[0]
+            d_real, d_mut = D.apply(
+                {"params": dp, "batch_stats": d_stats}, real,
+                train=True, mutable=["batch_stats"])
+            d_fake, d_mut = D.apply(
+                {"params": dp, "batch_stats": d_mut["batch_stats"]},
+                jax.lax.stop_gradient(fake), train=True,
+                mutable=["batch_stats"])
+            loss, _ = gan_losses(d_real, d_fake, d_fake)
+            return loss, d_mut["batch_stats"]
+        return d_loss
+
+    def make_g_loss(g_stats, d_stats):
+        def g_loss(gp, dp, z):
+            fake, g_mut = G.apply({"params": gp, "batch_stats": g_stats},
+                                  z, train=True, mutable=["batch_stats"])
+            logits, d_mut = D.apply({"params": dp, "batch_stats": d_stats},
+                                    fake, train=True,
+                                    mutable=["batch_stats"])
+            _, loss = gan_losses(logits, logits, logits)
+            return loss, (g_mut["batch_stats"], d_mut["batch_stats"])
+        return g_loss
+
+    @jax.jit
+    def train_step(gs, ds, g_stats, d_stats, z, real):
+        def scaled_d(dp):
+            l, stats = a_d.run(make_d_loss(g_stats, d_stats), dp,
+                               a_g.model_params(gs), z, real)
+            return a_d.scale_loss(l, ds), (l, stats)
+        d_grads, (dl, d_stats_) = \
+            jax.grad(scaled_d, has_aux=True)(a_d.model_params(ds))
+        ds, d_info = a_d.apply_gradients(ds, d_grads)
+
+        def scaled_g(gp):
+            l, stats = a_g.run(make_g_loss(g_stats, d_stats_), gp,
+                               a_d.model_params(ds), z)
+            return a_g.scale_loss(l, gs), (l, stats)
+        g_grads, (gl, (g_stats_, d_stats_2)) = \
+            jax.grad(scaled_g, has_aux=True)(a_g.model_params(gs))
+        gs, g_info = a_g.apply_gradients(gs, g_grads)
+        return (gs, ds, g_stats_, d_stats_2, dl, gl,
+                d_info["overflow"], g_info["overflow"],
+                d_info["loss_scale"], g_info["loss_scale"])
+
+    t0 = time.perf_counter()
+    d_over = g_over = 0
+    last_over_step = -1
+    independence_ok = not inject     # only assessable with injections
+    for i in range(steps):
+        kz, kr = jax.random.split(jax.random.PRNGKey(100 + i))
+        z = jax.random.normal(kz, (batch, zdim))
+        real = jnp.tanh(jax.random.normal(
+            kr, (batch, image_size, image_size, 3)))
+        if inject and i == inject[0]:
+            real = real.at[0, 0, 0, 0].set(jnp.inf)   # D-only fault
+        if len(inject) > 1 and i == inject[1]:
+            z = z.at[0, 0].set(jnp.inf)               # hits both nets
+        (gs, ds, g_stats, d_stats, dl, gl, d_o, g_o,
+         d_scale, g_scale) = train_step(gs, ds, g_stats, d_stats, z, real)
+        if inject and i == inject[0]:
+            # per-loss independence: the real-batch fault must trip D's
+            # scaler and leave G's untouched
+            independence_ok = bool(d_o) and not bool(g_o)
+        if bool(d_o):
+            d_over += 1
+            last_over_step = i
+        if bool(g_o):
+            g_over += 1
+            last_over_step = i
+    finite = bool(np.isfinite(float(dl)) and np.isfinite(float(gl)))
+    recovered = finite and last_over_step < steps - 1
+    return {"name": "dcgan_two_scaler", "steps": steps, "batch": batch,
+            "half_dtype": half_dtype, "inject_steps": list(inject),
+            "d_overflows": d_over, "g_overflows": g_over,
+            "last_overflow_step": last_over_step,
+            "scaler_independence_ok": independence_ok,
+            "final_d_loss": round(float(dl), 4),
+            "final_g_loss": round(float(gl), 4),
+            "final_d_scale": float(d_scale),
+            "final_g_scale": float(g_scale),
+            "wall_s": round(time.perf_counter() - t0, 1),
+            "ok": bool((d_over + g_over) > 0 and recovered
+                       and independence_ok)}
+
+
+def main():
+    out_path = Path(sys.argv[1] if len(sys.argv) > 1
+                    else REPO / "CONVERGENCE_r03.json")
+    corpus = _corpus()
+    records = {}
+    for fn in (lambda: run_gpt_pysrc(corpus=corpus),
+               # byte-level MLM learns slower than causal LM: 2400
+               # steps (~30 s on chip) to its plateau
+               lambda: run_bert_mlm(steps=2400, corpus=corpus),
+               # chip record: bf16 dynamics + targeted faults (see the
+               # runner's docstring for why fp16 is CPU-only)
+               lambda: run_dcgan_two_scaler(half_dtype="bfloat16",
+                                            inject=(60, 150))):
+        rec = fn()
+        records[rec["name"]] = rec
+        print(json.dumps(rec))
+    records["platform"] = str(jax.devices()[0])
+    records["all_ok"] = all(r.get("ok") for r in records.values()
+                            if isinstance(r, dict))
+    out_path.write_text(json.dumps(records, indent=1))
+    print(f"wrote {out_path}  all_ok={records['all_ok']}")
+
+
+if __name__ == "__main__":
+    main()
