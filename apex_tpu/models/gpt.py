@@ -120,7 +120,10 @@ def apply_rope_mxu(x: jax.Array, cos_full: jax.Array,
     ``cos_full = concat(cos, cos)``, ``sin_full = concat(sin, sin)``.
     """
     r = _rope_rot_matrix(x.shape[-1]).astype(x.dtype)
-    xr = x @ r
+    # precision="highest": with fp32 inputs the MXU's default bf16
+    # passes would round what must be an exact permutation (0/±1 rows);
+    # bf16 inputs are exact either way, and the matmul is tiny.
+    xr = jnp.matmul(x, r, precision="highest")
     out = (x.astype(jnp.float32) * cos_full
            + xr.astype(jnp.float32) * sin_full)
     return out.astype(x.dtype)

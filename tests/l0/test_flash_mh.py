@@ -19,6 +19,12 @@ from apex_tpu.ops.pallas.flash_mh import flash_attention_mh
 
 B, L, H, D = 2, 256, 4, 64
 SCALE = 1.0 / 8.0
+# On real hardware the MXU computes fp32 dots via bf16 passes (default
+# precision); interpret mode on CPU is exact fp32 — same tolerance split
+# as tests/l0/test_flash_attention.py.
+_ON_CPU = jax.default_backend() == "cpu"
+RTOL = 2e-5 if _ON_CPU else 2e-2
+ATOL = 2e-5 if _ON_CPU else 2e-2
 
 
 def _qkv(l=L, seed=0):
@@ -36,9 +42,9 @@ def test_mh_forward_matches_reference(causal):
     ref, rlse = _jnp_attention(q, k, v, causal=causal, kv_mask=None,
                                scale=SCALE, return_lse=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               rtol=2e-5, atol=2e-5)
+                               rtol=RTOL, atol=ATOL)
     np.testing.assert_allclose(np.asarray(lse), np.asarray(rlse),
-                               rtol=2e-5, atol=2e-5)
+                               rtol=RTOL, atol=ATOL)
 
 
 def test_mh_padded_mask_and_grads():
@@ -57,7 +63,8 @@ def test_mh_padded_mask_and_grads():
         (0, 1, 2))(q, k, v)
     for g, r in zip(got, want):
         np.testing.assert_allclose(np.asarray(g), np.asarray(r),
-                                   rtol=1e-4, atol=1e-4)
+                                   rtol=max(RTOL, 1e-4),
+                                   atol=max(ATOL, 1e-4))
 
 
 def test_bhld_layout_matches_blhd():
@@ -93,7 +100,7 @@ def test_bhld_cross_attention_falls_back():
     ref = _jnp_attention(q, k[:, :128], v[:, :128], causal=False,
                          kv_mask=None, scale=SCALE)
     np.testing.assert_allclose(np.asarray(jnp.moveaxis(out, 1, 2)),
-                               np.asarray(ref), rtol=2e-5, atol=2e-5)
+                               np.asarray(ref), rtol=RTOL, atol=ATOL)
 
 
 def test_rope_mxu_matches_concat_spelling():
@@ -107,6 +114,7 @@ def test_rope_mxu_matches_concat_spelling():
     cos_h = jnp.moveaxis(jnp.concatenate([cos, cos], -1), 1, 2)
     sin_h = jnp.moveaxis(jnp.concatenate([sin, sin], -1), 1, 2)
     got = jnp.moveaxis(apply_rope_mxu(xh, cos_h, sin_h), 1, 2)
+    # exact on both backends: the rotation matmul runs precision=highest
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-6, atol=1e-6)
 

@@ -25,8 +25,14 @@ REPO = Path(__file__).resolve().parents[1]
 SELECTION = [
     "tests/l0/test_fused_lamb.py",
     "tests/l0/test_flash_attention.py",
+    "tests/l0/test_flash_mh.py",
+    "tests/l0/test_conv1x1.py",
     "tests/l0/test_multi_tensor.py",
     "tests/l0/test_fused_adam.py",
+    # cross-commit numerical drift gate on the hardware platform
+    # (VERDICT r2 item 4a: the stored-baseline axis of the reference's
+    # tests/L1/common/compare.py, on the platform that matters)
+    "tests/l1/test_golden_digests.py",
     "tests/distributed/test_ring_attention.py::test_ring_flash_kernel_on_tpu",
     "tests/distributed/test_onchip_pallas_shardmap.py",
 ]
@@ -91,8 +97,13 @@ def main():
         "wall_s": wall,
         "rc": proc.returncode,
         "counts": counts,
+        # skips count against ok: on hardware NOTHING in the selection
+        # may skip — in particular the golden-digest drift gate
+        # pytest.skip()s when no baseline exists for the reported
+        # platform, and an all-skipped gate must not read as green
         "ok": proc.returncode == 0 and counts["failed"] == 0
-              and counts["error"] == 0 and counts["passed"] > 0,
+              and counts["error"] == 0 and counts["skipped"] == 0
+              and counts["passed"] > 0,
         "date": time.strftime("%Y-%m-%d %H:%M:%S"),
         "tail": proc.stdout[-1500:],
         "tests": tests,
