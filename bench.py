@@ -295,9 +295,13 @@ def main():
     # Real configs on TPU; tiny stand-ins on CPU so the script stays
     # runnable anywhere (the driver runs it on the real chip).
     if on_tpu:
-        rn_args = dict(batch=256, size=224, warmup=5, iters=30)
-        gpt_args = dict(batch=8, seq=2048, warmup=3, iters=20, tiny=False)
-        bert_args = dict(batch=16, seq=512, warmup=3, iters=15, tiny=False)
+        # iters sized so the full 10-config suite fits the time budget:
+        # measurement noise at these counts is ~1%, well under chip-day
+        # variance (+-2-4%), and the budget headroom keeps the optional
+        # long-context configs from being skipped
+        rn_args = dict(batch=256, size=224, warmup=4, iters=20)
+        gpt_args = dict(batch=8, seq=2048, warmup=3, iters=12, tiny=False)
+        bert_args = dict(batch=16, seq=512, warmup=3, iters=10, tiny=False)
     else:
         rn_args = dict(batch=8, size=64, warmup=1, iters=3)
         gpt_args = dict(batch=2, seq=64, warmup=1, iters=3, tiny=True)
@@ -314,9 +318,9 @@ def main():
     #: bert-large = the BASELINE set) always run.
     try:
         optional_budget_s = float(
-            os.environ.get("APEX_TPU_BENCH_BUDGET_S", 900))
+            os.environ.get("APEX_TPU_BENCH_BUDGET_S", 1500))
     except ValueError:  # malformed env must not cost the round's artifact
-        optional_budget_s = 900.0
+        optional_budget_s = 1500.0
 
     def record(name, fn, optional=False, fresh=False, **kw):
         if optional and time.perf_counter() - t_start > optional_budget_s:
