@@ -91,6 +91,27 @@ def test_bhld_layout_matches_blhd():
                                np.asarray(g_b), rtol=1e-6, atol=1e-6)
 
 
+def test_attention_dispatcher_bhld_routes_and_falls_back():
+    """attention(layout='bhld'): honors impl='jnp' (head-major in/out via
+    the jnp math), rejects sequence-parallel axes, and matches the blhd
+    dispatch numerically."""
+    from apex_tpu.attention import attention
+    q, k, v = _qkv(seed=5)
+    qh, kh, vh = (jnp.moveaxis(t, 1, 2) for t in (q, k, v))
+    out_jnp = attention(qh, kh, vh, impl="jnp", causal=True,
+                        layout="bhld")
+    want = attention(q, k, v, impl="jnp", causal=True)
+    np.testing.assert_allclose(np.asarray(jnp.moveaxis(out_jnp, 1, 2)),
+                               np.asarray(want), rtol=1e-5, atol=1e-5)
+    out_auto = attention(qh, kh, vh, causal=True, layout="bhld")
+    # auto-dispatch hits the Pallas kernel on hardware: platform tols
+    np.testing.assert_allclose(np.asarray(jnp.moveaxis(out_auto, 1, 2)),
+                               np.asarray(want), rtol=max(RTOL, 1e-4),
+                               atol=max(ATOL, 1e-4))
+    with pytest.raises(ValueError, match="bhld"):
+        attention(qh, kh, vh, axis_name="seq", layout="bhld")
+
+
 def test_bhld_cross_attention_falls_back():
     q, k, v = _qkv(seed=3)
     qh = jnp.moveaxis(q, 1, 2)
