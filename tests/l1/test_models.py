@@ -299,3 +299,32 @@ class TestBertScanRemat:
                 # elements wobble at ~1e-5 absolute; structure must agree
                 np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                            rtol=1e-3, atol=1e-4)
+
+
+class TestBertHeadWidthDispatch:
+    """SelfAttention dispatches by head width under the kernel gate:
+    narrow heads ride the head-major layout, wide heads (>= 128) the
+    split+flash path — both must match the jnp einsum branch (which the
+    tiny default configs alone never check for the wide branch)."""
+
+    @pytest.mark.parametrize("num_heads,label", [(4, "narrow-32"),
+                                                 (1, "wide-128")])
+    def test_pallas_branches_match_jnp(self, monkeypatch, num_heads,
+                                       label):
+        import dataclasses as dc
+        cfg = dc.replace(bert_tiny(), num_heads=num_heads)
+        model = BertForPreTraining(cfg)
+        rng = np.random.RandomState(3)
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 16)))
+        mask = jnp.ones((2, 16), jnp.int32).at[:, -3:].set(0)
+
+        monkeypatch.setenv("APEX_TPU_KERNELS", "jnp")
+        variables = model.init(jax.random.PRNGKey(0), ids,
+                               attention_mask=mask)
+        mlm_jnp, _ = model.apply(variables, ids, attention_mask=mask)
+
+        monkeypatch.setenv("APEX_TPU_KERNELS", "pallas")
+        mlm_pl, _ = model.apply(variables, ids, attention_mask=mask)
+        np.testing.assert_allclose(
+            np.asarray(mlm_pl, np.float32), np.asarray(mlm_jnp, np.float32),
+            rtol=5e-2, atol=5e-2, err_msg=label)
