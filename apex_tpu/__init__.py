@@ -32,6 +32,7 @@ compute/communication overlap that apex's bucketed NCCL streams did by hand.
 
 from apex_tpu import amp
 from apex_tpu import checkpoint
+from apex_tpu import data
 from apex_tpu import fp16_utils
 from apex_tpu import multi_tensor_apply
 from apex_tpu import normalization
@@ -49,6 +50,7 @@ __version__ = "0.1.0"
 __all__ = [
     "amp",
     "checkpoint",
+    "data",
     "fp16_utils",
     "multi_tensor_apply",
     "normalization",

@@ -8,6 +8,7 @@ plus the functional state machine pieces that replace eager monkey-patching:
 """
 
 from apex_tpu.amp import lists, ops
+from apex_tpu.amp.audit import audit, audit_text, format_report
 from apex_tpu.amp.frontend import (
     Amp,
     AmpState,
@@ -43,6 +44,7 @@ __all__ = [
     "Properties", "O0", "O1", "O2", "O3", "opt_levels", "resolve", "DYNAMIC",
     "LossScaler", "LossScaleState", "all_finite",
     "ops", "lists",
+    "audit", "audit_text", "format_report",
     "cast_context", "disable_casts",
     "half_function", "float_function", "promote_function", "banned_function",
     "register_half_function", "register_float_function",

@@ -97,13 +97,28 @@ class Amp:
         """Derive the carried ("master") representation of a param subtree
         — fp32 clones under master weights, compute-precision otherwise.
         Shared by :meth:`init` and :meth:`add_params` so the policy cannot
-        diverge between original and later-added subtrees."""
+        diverge between original and later-added subtrees.
+
+        Every leaf is a genuine CLONE (reference ``_initialize.py``
+        ``.clone()`` semantics): ``astype`` to an unchanged dtype is an
+        aliasing no-op in JAX, and an aliased master means a
+        ``donate_argnums`` train step silently deletes the CALLER'S
+        params — a later ``a.init(params)`` then builds a state of dead
+        buffers (surfaces as an opaque INVALID_ARGUMENT on TPU)."""
         p = self.properties
+
+        def clone(x, dtype=None):
+            if dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
+                return jnp.array(x, dtype=dtype, copy=True)
+            return jnp.array(x, copy=True)
+
         if p.enabled and self._use_master_weights():
-            return jax.tree.map(
-                lambda x: x.astype(jnp.float32)
-                if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
-        return self.model_params_from(params)
+            return jax.tree.map(lambda x: clone(x, jnp.float32), params)
+        # single pass: clone() with the policy's cast dtype materializes
+        # copy and cast together (model_params_from-then-clone would
+        # copy changed-dtype leaves twice)
+        return jax.tree_util.tree_map_with_path(
+            lambda path, x: clone(x, self._cast_leaf_dtype(path)), params)
 
     def _use_master_weights(self) -> bool:
         p = self.properties

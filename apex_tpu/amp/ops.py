@@ -220,19 +220,37 @@ dot_general = half_function(lax.dot_general)
 
 def _conv_general_dilated(x, kernel, window_strides, padding,
                           lhs_dilation=None, rhs_dilation=None,
-                          dimension_numbers=None, **kwargs):
-    """lax.conv_general_dilated signature, with eligible 1x1 stride-1
-    NHWC convs routed to the fused-backward kernel when opted in (the
-    RN50 conv-MFU campaign — see :mod:`apex_tpu.ops.pallas.conv1x1`)."""
-    from apex_tpu.ops.pallas import conv1x1 as c1
+                          dimension_numbers=None, feature_group_count=1,
+                          batch_group_count=1, precision=None,
+                          preferred_element_type=None, **kwargs):
+    """Full lax.conv_general_dilated positional signature (so callers
+    passing feature/batch_group_count or precision positionally stay
+    drop-in compatible), with eligible 1x1 stride-1 NHWC convs routed to
+    the fused-backward kernel when opted in (the RN50 conv-MFU
+    campaign — see :mod:`apex_tpu.ops.pallas.experimental.conv1x1`)."""
+    from apex_tpu.ops.pallas.experimental import conv1x1 as c1
+    # only NON-default extras disqualify kernel routing
+    extras = dict(kwargs)
+    if feature_group_count != 1:
+        extras["feature_group_count"] = feature_group_count
+    if batch_group_count != 1:
+        extras["batch_group_count"] = batch_group_count
+    if precision is not None:
+        extras["precision"] = precision
+    if preferred_element_type is not None:
+        extras["preferred_element_type"] = preferred_element_type
     if (lhs_dilation is None and rhs_dilation is None
             and c1.routeable(x, kernel, window_strides, padding,
-                             dimension_numbers, kwargs)):
+                             dimension_numbers, extras)):
         return c1.conv1x1(x, kernel)
     return lax.conv_general_dilated(x, kernel, window_strides, padding,
                                     lhs_dilation=lhs_dilation,
                                     rhs_dilation=rhs_dilation,
                                     dimension_numbers=dimension_numbers,
+                                    feature_group_count=feature_group_count,
+                                    batch_group_count=batch_group_count,
+                                    precision=precision,
+                                    preferred_element_type=preferred_element_type,
                                     **kwargs)
 
 

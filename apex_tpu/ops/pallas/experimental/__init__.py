@@ -1,0 +1,27 @@
+"""apex_tpu.ops.pallas.experimental — measured-negative parked kernels.
+
+Everything in this package is a REAL, numerics-pinned implementation that
+was benchmarked in-model on the v5e chip and measured SLOWER than the
+production path it was built to replace.  Nothing here is imported by a
+default code path; each module's docstring records the measurement and
+the mechanism (why it loses), so the negative result is reproducible and
+the idea is not silently re-tried.
+
+Current inventory:
+
+- :mod:`.flash_mh` — multi-head BLHD-native flash attention (head-packed
+  score tiles).  2.08-2.49 ms vs 1.56 ms for the production BHLD kernel
+  at d=64: Mosaic keeps per-head fp32 score tiles live across unrolled
+  head loops, so packing heads raises VMEM pressure instead of MXU
+  occupancy.
+- :mod:`.conv1x1` — fused 1x1-conv backward.  Wins isolated, −58%
+  end-to-end in ResNet-50: pulling the conv out of XLA breaks the
+  elementwise-into-conv-operand fusions (BN/relu chains) that the
+  surrounding graph relies on.
+
+Tests for these modules carry the ``experimental`` pytest marker; the
+on-chip suite (``tools/onchip_run.py``) keeps ONE numerics pin per
+kernel so drift is still caught without spending chip minutes on shelf
+inventory.  Production kernels live one package up in
+``apex_tpu/ops/pallas/``.
+"""

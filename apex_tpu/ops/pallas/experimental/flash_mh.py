@@ -285,12 +285,19 @@ def _pad_l(t, lp):
 def _vmem_fits(block_q, block_k, hd, H, D, itemsize) -> bool:
     """Stats + acc scratch and double-buffered blocks within ~11 MB
     (16 MB scoped limit minus headroom for the transient score tile —
-    the bwd's scratch is (H, bk, D) x2 which the max() term covers)."""
+    the bwd's scratch is (H, bk, D) x2 which the max() term covers).
+
+    Budgets INPUT blocks and OUTPUT blocks: the backward's outputs are
+    fp32 dq-partials (block_q, hd) plus dk/dv blocks (block_k, hd),
+    each double-buffered by the pipeline — omitting them let block
+    selection exceed the intended headroom near the cap (ADVICE r3)."""
     scr = 4 * H * block_q * (2 * _STATS_W) \
         + 4 * H * max(block_q, block_k) * D * 2
     blocks = 2 * itemsize * hd * (2 * block_q + 2 * block_k)
+    out_blocks = 2 * 4 * block_q * hd \
+        + 2 * itemsize * hd * (block_q + 2 * block_k)
     score = 4 * block_q * block_k * 2
-    return scr + blocks + score <= 11 * 1024 * 1024
+    return scr + blocks + out_blocks + score <= 11 * 1024 * 1024
 
 
 def _mh_default_blocks(l, hd, H, D, itemsize):

@@ -48,20 +48,26 @@ import flax.linen as nn
 from jax import lax
 
 
-def welford_mean_var(x: jax.Array, reduce_axes: Sequence[int]):
-    """Local per-channel (mean, biased var, count) in fp32
-    (``syncbn.welford_mean_var``).
+def local_mean_var(x: jax.Array, reduce_axes: Sequence[int]):
+    """Local per-channel (mean, biased var, count) in fp32.
 
-    Computed as the one-pass ``E[x^2] - E[x]^2`` pair: both reductions
-    read ``x`` once and XLA fuses them into a single pass (often into
-    the producing conv's epilogue).  The two-pass centered formulation
-    (``x.var()``) re-reads the full activation to square the residuals —
-    measured +7% on the whole RN50 b256 step (round 3).  fp32
-    accumulation over normalized-scale activations keeps the
-    cancellation benign (the same trade cuDNN and flax make); the
-    *cross-device* merge stays Chan's algorithm (:func:`welford_parallel`),
-    which is where single-pass numerics would actually bite (large
-    disjoint populations)."""
+    Computed as the one-pass ``E[x^2] - E[x]^2`` pair — NOT Welford's
+    update: both reductions read ``x`` once and XLA fuses them into a
+    single pass (often into the producing conv's epilogue).  The
+    two-pass centered formulation (``x.var()``) re-reads the full
+    activation to square the residuals — measured +7% on the whole RN50
+    b256 step (round 3).
+
+    Numerics regime: single-pass cancellation loses ``~2*log2(|mean|/
+    std)`` bits of the variance.  fp32 accumulation (24 mantissa bits)
+    over BN-scale activations (|mean|/std of order 1-10^2, as produced
+    by normalized nets) keeps that loss ≤ ~14 bits — far above the
+    1e-5 tolerance SyncBN guarantees (BASELINE.md); the same trade
+    cuDNN and flax make.  A pathological |mean|/std ≳ 10^3 regime would
+    bite, but can't arise between BN layers that themselves normalize.
+    The *cross-device* merge stays Chan's algorithm
+    (:func:`welford_parallel`), which is where single-pass numerics
+    would actually bite (large disjoint populations)."""
     x32 = x.astype(jnp.float32)
     count = 1
     for a in reduce_axes:
@@ -70,6 +76,14 @@ def welford_mean_var(x: jax.Array, reduce_axes: Sequence[int]):
     mean_sq = jnp.square(x32).mean(axis=tuple(reduce_axes))
     var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)  # biased
     return mean, var, count
+
+
+#: Reference-parity export spelling (``syncbn.welford_mean_var``,
+#: SURVEY §2.1 #19).  The NAME is historical — the reference's local
+#: stats kernel is Welford (`welford.cu`); this implementation is the
+#: one-pass pair documented in :func:`local_mean_var` (ADVICE r3: keep
+#: the parity spelling, name the real algorithm honestly).
+welford_mean_var = local_mean_var
 
 
 def welford_parallel(means: jax.Array, vars_: jax.Array,

@@ -78,6 +78,13 @@ def parse_args():
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest checkpoint in "
                         "--checkpoint-dir (reference --resume)")
+    p.add_argument("--data-pipeline", default="device",
+                   choices=["device", "host"],
+                   help="device: batches generated device-resident "
+                        "(fastest); host: uint8 numpy batches streamed "
+                        "through apex_tpu.data.prefetch_to_device with "
+                        "on-device normalization — the reference "
+                        "data_prefetcher pattern (main_amp.py:256-290)")
     p.add_argument("--data", default="synthetic",
                    choices=["synthetic", "digits"],
                    help="synthetic stream, or the sklearn digits set "
@@ -281,6 +288,12 @@ def main():
     n_dev = len(jax.devices()) if args.dp else 1
 
     real_data = args.data != "synthetic"
+    if real_data and args.data_pipeline == "host":
+        # fail loudly rather than silently measuring the device path:
+        # the digits set is staged once (it fits on chip), so there is
+        # no host stream to exercise there
+        raise SystemExit("--data-pipeline host applies to --data "
+                         "synthetic only; digits is device-staged")
     num_classes = 1000
     if real_data:
         train_x, train_y, val_x, val_y, num_classes = \
@@ -409,9 +422,26 @@ def main():
     last_i = start_step - 1
     warm_t0 = warm_i0 = None
     inst = 0.0
-    for i in range(start_step, steps):
-        kx = jax.random.PRNGKey(seed + i + 1)
-        x, y = synthetic_batch(kx, global_batch, args.image_size)
+    if args.data_pipeline == "host":
+        from apex_tpu.data import (host_synthetic_loader, normalize_uint8,
+                                   prefetch_to_device)
+        sharding = None
+        if args.dp:
+            from jax.sharding import NamedSharding
+            sharding = NamedSharding(mesh, P("data"))
+        batches = prefetch_to_device(
+            host_synthetic_loader(steps - start_step, global_batch,
+                                  args.image_size, seed),
+            lookahead=2, sharding=sharding, transform=normalize_uint8)
+        maybe_print("host-streamed input pipeline: uint8 numpy batches, "
+                    "H2D + on-device normalize overlapped (lookahead 2)")
+    else:
+        def _device_batches():
+            for j in range(start_step, steps):
+                kx = jax.random.PRNGKey(seed + j + 1)
+                yield synthetic_batch(kx, global_batch, args.image_size)
+        batches = _device_batches()
+    for i, (x, y) in zip(range(start_step, steps), batches):
         state, batch_stats, loss, scale = step(state, batch_stats, x, y)
         if mgr is not None and (i + 1) % args.checkpoint_freq == 0:
             mgr.save(i, state,

@@ -201,3 +201,33 @@ def test_repeated_casts_track_fp32_reference():
         return float(m["loss"])
 
     np.testing.assert_allclose(run("O1"), run("O0"), rtol=0.05, atol=0.02)
+
+
+def test_init_masters_never_alias_caller_params():
+    """Donation safety: ``a.init(params)`` must CLONE every leaf
+    (reference ``_initialize.py`` clones masters).  ``astype`` to an
+    unchanged dtype aliases in JAX, so without the clone a
+    ``donate_argnums=(0,)`` step deletes the caller's params — a later
+    ``a.init(params)`` then dies with "Array has been deleted" (an
+    opaque INVALID_ARGUMENT on TPU).  Pinned for every opt level and a
+    non-floating leaf."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import amp
+
+    params = {"w": jnp.ones((4, 4), jnp.float32),
+              "b": jnp.zeros((4,), jnp.float32),
+              "step_count": jnp.zeros((), jnp.int32)}
+    for lvl in ("O0", "O1", "O2", "O3"):
+        a = amp.initialize(opt_level=lvl, verbosity=0)
+        state = a.init(params)
+        src = {jax.tree_util.keystr(k): v
+               for k, v in jax.tree_util.tree_leaves_with_path(params)}
+        for path, leaf in jax.tree_util.tree_leaves_with_path(
+                state.master_params):
+            key = jax.tree_util.keystr(path)
+            assert leaf is not src[key], (lvl, key)
+        # deleting the state must leave params fully usable
+        jax.tree.map(lambda x: x.delete(), state.master_params)
+        assert float(params["w"].sum()) == 16.0

@@ -12,7 +12,9 @@ import numpy as np
 import pytest
 from jax import lax
 
-from apex_tpu.ops.pallas import conv1x1 as c1
+from apex_tpu.ops.pallas.experimental import conv1x1 as c1
+
+pytestmark = pytest.mark.experimental
 
 DN = ("NHWC", "HWIO", "NHWC")
 

@@ -1,0 +1,115 @@
+"""apex_tpu.data — the host→device prefetch pipeline (VERDICT r3 #4).
+
+Correctness pins for the overlapped input pipeline: ordering and
+completeness, pytree batches, the on-device transform, the lookahead
+contract (the source IS consumed ahead — that's the overlap), sharding
+placement on a multi-device mesh, and the reference-shaped
+``DataPrefetcher.next()`` sentinel protocol
+(``reference examples/imagenet/main_amp.py:256-290``).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from apex_tpu.data import DataPrefetcher, prefetch_to_device
+
+
+def _batches(n, start=0):
+    for i in range(start, start + n):
+        yield {"x": np.full((4, 8), i, np.float32),
+               "y": np.full((4,), i, np.int32)}
+
+
+def test_order_and_completeness():
+    out = list(prefetch_to_device(_batches(7), lookahead=2))
+    assert len(out) == 7
+    for i, b in enumerate(out):
+        assert isinstance(b["x"], jax.Array)
+        np.testing.assert_array_equal(np.asarray(b["x"]),
+                                      np.full((4, 8), i, np.float32))
+        np.testing.assert_array_equal(np.asarray(b["y"]),
+                                      np.full((4,), i, np.int32))
+
+
+def test_fewer_batches_than_lookahead():
+    assert len(list(prefetch_to_device(_batches(1), lookahead=4))) == 1
+    assert list(prefetch_to_device(_batches(0), lookahead=2)) == []
+
+
+def test_transform_runs_on_device_arrays():
+    def normalize(b):
+        return {"x": b["x"] / 2.0, "y": b["y"]}
+
+    out = list(prefetch_to_device(_batches(3), lookahead=2,
+                                  transform=normalize))
+    np.testing.assert_allclose(np.asarray(out[2]["x"]),
+                               np.full((4, 8), 1.0, np.float32))
+
+
+def test_uint8_normalize_pattern():
+    # the intended usage: uint8 over the wire, fp32 on device
+    def src():
+        yield np.arange(16, dtype=np.uint8).reshape(4, 4), \
+            np.zeros((4,), np.int32)
+
+    def normalize(b):
+        x, y = b
+        return x.astype(jnp.float32) / 255.0, y
+
+    (x, y), = list(prefetch_to_device(src(), transform=normalize))
+    assert x.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(x)[0, 1], 1 / 255.0)
+
+
+def test_lookahead_consumes_source_ahead():
+    """The whole point: while the consumer holds batch 0, the source
+    must already have produced ``lookahead`` more — that production is
+    what overlaps the step's compute."""
+    produced = []
+
+    def recording(n):
+        for i in range(n):
+            produced.append(i)
+            yield np.full((2,), i, np.float32)
+
+    gen = prefetch_to_device(recording(6), lookahead=3)
+    first = next(gen)
+    np.testing.assert_array_equal(np.asarray(first), [0.0, 0.0])
+    # 0..2 were produced to fill the queue, and pulling one batch
+    # produced one more
+    assert produced == [0, 1, 2, 3]
+
+
+def test_lookahead_must_be_positive():
+    with pytest.raises(ValueError, match="lookahead"):
+        next(prefetch_to_device(_batches(2), lookahead=0))
+
+
+def test_sharding_places_leaves_on_mesh():
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs the multi-device virtual mesh")
+    mesh = Mesh(np.array(devs[:4]), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    out = list(prefetch_to_device(_batches(2), lookahead=2,
+                                  sharding=sharding))
+    for b in out:
+        assert b["x"].sharding.is_equivalent_to(sharding, b["x"].ndim)
+
+
+def test_data_prefetcher_sentinel_protocol():
+    pf = DataPrefetcher(_batches(2))
+    seen = 0
+    batch = pf.next()
+    while batch is not None:
+        seen += 1
+        batch = pf.next()
+    assert seen == 2
+    assert pf.next() is None  # stays exhausted
+
+
+def test_data_prefetcher_is_iterable():
+    assert len(list(DataPrefetcher(_batches(3)))) == 3

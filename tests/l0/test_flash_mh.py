@@ -15,7 +15,7 @@ import pytest
 
 from apex_tpu.ops.pallas.flash_attention import _jnp_attention, \
     flash_attention
-from apex_tpu.ops.pallas.flash_mh import flash_attention_mh
+from apex_tpu.ops.pallas.experimental.flash_mh import flash_attention_mh
 
 B, L, H, D = 2, 256, 4, 64
 SCALE = 1.0 / 8.0
@@ -35,6 +35,7 @@ def _qkv(l=L, seed=0):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.experimental
 def test_mh_forward_matches_reference(causal):
     q, k, v = _qkv()
     out, lse = flash_attention_mh(q, k, v, causal=causal, block_q=128,
@@ -47,6 +48,7 @@ def test_mh_forward_matches_reference(causal):
                                rtol=RTOL, atol=ATOL)
 
 
+@pytest.mark.experimental
 def test_mh_padded_mask_and_grads():
     q, k, v = _qkv(l=200, seed=1)          # padding active
     mask = jnp.asarray(np.random.RandomState(1).rand(B, 200) > 0.2

@@ -49,3 +49,130 @@ def test_step_flops_fallback():
         def cost_analysis(self):
             return {"flops": 0.0}
     assert bench.step_flops(ZeroCost(), fallback=5.0) == 5.0
+
+
+def _write_bench(tmp_path, name, configs):
+    import json
+    p = tmp_path / name
+    p.write_text(json.dumps({"configs": configs}))
+    return str(p)
+
+
+def test_compare_configs_flags_only_real_drops(tmp_path):
+    prior = _write_bench(tmp_path, "BENCH_r03.json", {
+        "resnet50_o2": {"img_s": 1000.0},
+        "gpt_small_o2": {"tok_s": 50000.0},
+        "bert_large_lamb_o2": {"seq_s": 100.0},
+        "errored_before": {"error": "OOM"},
+    })
+    verdict = bench.compare_configs(prior, {
+        "resnet50_o2": {"img_s": 960.0},        # -4%: within variance
+        "gpt_small_o2": {"tok_s": 40000.0},     # -20%: regression
+        "bert_large_lamb_o2": {"error": "OOM"},  # errored now: uncompared
+        "errored_before": {"seq_s": 5.0},        # errored then: uncompared
+        "brand_new_cfg": {"img_s": 1.0},         # no baseline: uncompared
+    }, threshold=0.10)
+    assert verdict["regressions"] == ["gpt_small_o2"]
+    assert not verdict["ok"]
+    assert verdict["deltas"]["resnet50_o2"] == -0.04
+    assert set(verdict["uncompared"]) == {
+        "bert_large_lamb_o2", "errored_before", "brand_new_cfg"}
+
+
+def test_compare_configs_ok_within_threshold(tmp_path):
+    prior = _write_bench(tmp_path, "BENCH_r03.json",
+                         {"resnet50_o2": {"img_s": 1000.0}})
+    verdict = bench.compare_configs(
+        prior, {"resnet50_o2": {"img_s": 930.0}}, threshold=0.10)
+    assert verdict["ok"] and not verdict["regressions"]
+
+
+def test_compare_configs_unwraps_driver_artifact(tmp_path):
+    import json
+    p = tmp_path / "BENCH_r03.json"  # driver shape: payload under "parsed"
+    p.write_text(json.dumps({
+        "n": 3, "rc": 0, "tail": "...",
+        "parsed": {"configs": {"resnet50_o2": {"img_s": 1000.0}}}}))
+    verdict = bench.compare_configs(
+        str(p), {"resnet50_o2": {"img_s": 800.0}}, threshold=0.10)
+    assert verdict["regressions"] == ["resnet50_o2"]
+
+
+def test_compare_against_real_r03_artifact():
+    # the shipped round-3 artifact must be readable by the gate
+    verdict = bench.compare_configs(
+        str(REPO / "BENCH_r03.json"),
+        {"resnet50_o2": {"img_s": 2461.55}}, threshold=0.10)
+    assert verdict["deltas"]["resnet50_o2"] == 0.0
+    assert verdict["ok"]
+
+
+def test_compare_configs_unreadable_baseline_never_fails(tmp_path):
+    bad = tmp_path / "BENCH_r99.json"
+    bad.write_text("{not json")
+    verdict = bench.compare_configs(str(bad), {"a": {"img_s": 1.0}})
+    assert verdict["ok"] and "error" in verdict
+
+
+def test_find_prior_bench_picks_newest_round(tmp_path):
+    for n in (1, 3, 2):
+        _write_bench(tmp_path, f"BENCH_r{n:02d}.json", {})
+    assert bench.find_prior_bench(str(tmp_path)).endswith("BENCH_r03.json")
+    assert bench.find_prior_bench(str(tmp_path / "empty")) is None
+
+
+def test_repo_has_prior_bench_artifact():
+    # the real repo carries round artifacts; the default gate must find one
+    assert bench.find_prior_bench(str(REPO)) is not None
+
+
+def test_mfu_vs_hfu_pass_counts():
+    # MFU books 6 analytic attention passes (PaLM model-FLOPs
+    # convention); HFU books the 7 the fused backward actually runs.
+    assert bench.ATTN_MODEL_PASSES == 6
+    assert bench.ATTN_FUSED_EXEC_PASSES == 7
+
+
+def test_pallas_attn_compiled_detection():
+    class Hlo:
+        def __init__(self, txt):
+            self._txt = txt
+
+        def as_text(self):
+            return self._txt
+
+    # detection must be attention-specific: a fused-optimizer or
+    # layer-norm custom call in the step must NOT vouch for the
+    # attention kernel path (it would re-introduce the double count)
+    assert bench._pallas_attn_compiled(Hlo(
+        '%jvp_jit__flash_fwd__.1 = custom-call(...), '
+        'custom_call_target="tpu_custom_call", metadata={op_name='
+        '"jit(f)/jvp(jit(_flash_fwd))/pallas_call"}'))
+    assert bench._pallas_attn_compiled(Hlo(
+        'op_name="jit(f)/transpose(jvp(jit(_flash_bwd_fused)))/'
+        'pallas_call"'))
+    assert not bench._pallas_attn_compiled(Hlo(
+        '%_lamb_stage1.3 = custom-call(...), '
+        'custom_call_target="tpu_custom_call"'))
+    assert not bench._pallas_attn_compiled(Hlo("fusion(...) dot(...)"))
+
+    class NoText:
+        def as_text(self):
+            raise NotImplementedError
+    assert bench._pallas_attn_compiled(NoText()) is None
+
+
+def test_compare_configs_lists_prior_only_and_ungated(tmp_path):
+    prior = _write_bench(tmp_path, "BENCH_r03.json", {
+        "gpt_small_o2": {"tok_s": 50000.0},
+        "resnet50_o2_hoststream": {"img_s": 400.0},
+        "deleted_config": {"img_s": 9.0},
+    })
+    verdict = bench.compare_configs(prior, {
+        "gpt_small_o2": {"tok_s": 49000.0},
+        # wire-speed config: a 50% swing must NOT fail the gate
+        "resnet50_o2_hoststream": {"img_s": 200.0},
+    }, threshold=0.10)
+    assert verdict["ok"]
+    assert "resnet50_o2_hoststream" in verdict["uncompared"]
+    assert "deleted_config" in verdict["uncompared"]  # baseline-only

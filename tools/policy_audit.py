@@ -1,0 +1,134 @@
+"""O1 policy audit over the in-tree model families.
+
+The reference guarantees O1 coverage by patching the whole ``torch``
+namespace (``apex/amp/amp.py:68-177``); apex_tpu's equivalent guarantee
+is checkable instead of structural: :func:`apex_tpu.amp.audit` walks the
+lowered StableHLO of an O1 forward and flags FP32-list-category work
+executing in 16-bit (see ``apex_tpu/amp/audit.py``).
+
+This tool runs that audit over the four in-tree model families' O1
+forwards (MLP, ResNet, GPT, BERT — tiny configs; lowering only, nothing
+executes) and prints one JSON line per family plus a summary.  Exit
+status 1 if any family has violations — wired as a test in
+``tests/l0/test_policy_audit.py`` so the guarantee is continuously
+enforced, and runnable standalone on user models:
+
+    from apex_tpu import amp
+    a = amp.initialize(opt_level="O1", verbosity=0)
+    report = amp.audit(lambda p, x: a.run(model.apply, p, x), params, x)
+    print(amp.format_report(report))
+"""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from apex_tpu import amp  # noqa: E402
+
+
+def _wrap(a, loss_fn):
+    """The audited program: the O1 forward exactly as make_train_step
+    runs it (cast context active), loss included."""
+    return lambda params, *batch: a.run(loss_fn, params, *batch)
+
+
+def mlp_case():
+    from apex_tpu.models.mlp import MLP, cross_entropy_loss
+    model = MLP(features=(32,))
+    x = jnp.ones((4, 28, 28, 1), jnp.float32)
+    y = jnp.zeros((4,), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    a = amp.initialize(opt_level="O1", verbosity=0)
+
+    def loss_fn(p, xb, yb):
+        return cross_entropy_loss(model.apply({"params": p}, xb), yb)
+    return _wrap(a, loss_fn), (params, x, y)
+
+
+def resnet_case():
+    from apex_tpu.models.resnet import ResNet
+    model = ResNet(stage_sizes=(1, 1), num_classes=10, width=8)
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    y = jnp.zeros((2,), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    a = amp.initialize(opt_level="O1", verbosity=0)
+
+    def loss_fn(p, xb, yb):
+        logits, _ = model.apply({"params": p, "batch_stats": batch_stats},
+                                xb, train=True, mutable=["batch_stats"])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.mean(jnp.take_along_axis(logp, yb[:, None], 1))
+    return _wrap(a, loss_fn), (params, x, y)
+
+
+def gpt_case():
+    from apex_tpu.models.gpt import GPTModel, gpt_tiny, lm_loss
+    model = GPTModel(gpt_tiny())
+    ids = jnp.zeros((2, 32), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    a = amp.initialize(opt_level="O1", verbosity=0)
+
+    def loss_fn(p, xb):
+        logits = model.apply({"params": p}, xb)
+        return lm_loss(logits[:, :-1], xb[:, 1:])
+    return _wrap(a, loss_fn), (params, ids)
+
+
+def bert_case():
+    from apex_tpu.models.bert import (BertForPreTraining, bert_tiny,
+                                      pretraining_loss)
+    model = BertForPreTraining(bert_tiny())
+    ids = jnp.zeros((2, 32), jnp.int32)
+    mlm_labels = jnp.zeros((2, 32), jnp.int32)
+    mlm_mask = jnp.ones((2, 32), jnp.float32)
+    nsp_labels = jnp.zeros((2,), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids[:, :8])["params"]
+    a = amp.initialize(opt_level="O1", verbosity=0)
+
+    def loss_fn(p, ids, mlm_labels, nsp_labels, mlm_mask):
+        mlm_logits, nsp_logits = model.apply({"params": p}, ids)
+        return pretraining_loss(mlm_logits, nsp_logits, mlm_labels,
+                                nsp_labels, mlm_mask)
+    return _wrap(a, loss_fn), (params, ids, mlm_labels, nsp_labels,
+                               mlm_mask)
+
+
+CASES = {
+    "mlp": mlp_case,
+    "resnet": resnet_case,
+    "gpt": gpt_case,
+    "bert": bert_case,
+}
+
+
+def run_all() -> dict:
+    reports = {}
+    for name, case in CASES.items():
+        fn, args = case()
+        reports[name] = amp.audit(fn, *args)
+    return reports
+
+
+def main() -> int:
+    reports = run_all()
+    for name, rep in reports.items():
+        print(json.dumps({"family": name, **rep}))
+    bad = [n for n, r in reports.items() if not r["ok"]]
+    if bad:
+        print(f"policy audit FAILED for: {bad}", file=sys.stderr)
+        for n in bad:
+            print(f"--- {n} ---\n{amp.format_report(reports[n])}",
+                  file=sys.stderr)
+        return 1
+    print("policy audit: all families OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
