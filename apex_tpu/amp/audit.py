@@ -94,11 +94,38 @@ def audit_text(stablehlo_text: str) -> dict:
     violations: dict[tuple, dict] = {}
     fp32_matmuls = 0
     custom_calls = 0
+
+    def flag_reduce(dtype, lineno, line):
+        key = ("reduce", dtype)
+        rec = violations.setdefault(key, {
+            "op": "reduce", "dtype": dtype,
+            "category": "16-bit accumulation",
+            "count": 0, "first_line": lineno,
+            "example": line.strip()[:200]})
+        rec["count"] += 1
+
+    # a generic-form reduce (multi-result / custom reducer) prints its
+    # header WITHOUT an ``applies`` clause; the adds live in a
+    # ``reducer(...) { ... stablehlo.return }`` region on the following
+    # lines.  Track the open region's header so a lossy op inside it is
+    # attributed to the reduce, not missed.
+    open_reduce = None  # (operand dtype, header lineno, header line)
+
     for lineno, line in enumerate(stablehlo_text.splitlines(), 1):
         m = _OP_LINE.search(line)
         if not m:
+            if open_reduce and "stablehlo.return" in line:
+                open_reduce = None
             continue
         op = m.group(1)
+        if open_reduce is not None:
+            if op in ("add", "multiply"):
+                flag_reduce(open_reduce[0], open_reduce[1], open_reduce[2])
+                open_reduce = None
+                continue
+            if op == "return":
+                open_reduce = None
+                continue
         if op in BLACKLIST_POINTWISE:
             dtype = _result_elem_type(line)
             if dtype in _HALF_DTYPES:
@@ -110,18 +137,15 @@ def audit_text(stablehlo_text: str) -> dict:
                     "example": line.strip()[:200]})
                 rec["count"] += 1
         elif op == "reduce":
+            # operand dtype = FIRST tensor token (the reduce input);
+            # jnp's own upcasts make this f32, raw lax.reduce won't
+            types = _elem_types(line)
+            half_in = bool(types) and types[0] in _HALF_DTYPES
             if any(fn in line for fn in _LOSSY_REDUCE_FNS):
-                # operand dtype = FIRST tensor token (the reduce input);
-                # jnp's own upcasts make this f32, raw lax.reduce won't
-                types = _elem_types(line)
-                if types and types[0] in _HALF_DTYPES:
-                    key = ("reduce", types[0])
-                    rec = violations.setdefault(key, {
-                        "op": "reduce", "dtype": types[0],
-                        "category": "16-bit accumulation",
-                        "count": 0, "first_line": lineno,
-                        "example": line.strip()[:200]})
-                    rec["count"] += 1
+                if half_in:
+                    flag_reduce(types[0], lineno, line)
+            elif "applies" not in line and half_in:
+                open_reduce = (types[0], lineno, line)
         elif op in ("dot_general", "dot", "convolution"):
             if _result_elem_type(line) == "f32":
                 fp32_matmuls += 1

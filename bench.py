@@ -418,11 +418,18 @@ def compare_configs(prior_path: str, configs: dict,
             doc = json.load(f)
         # the driver's BENCH_r{N}.json wraps the bench line under
         # "parsed" (raw stdout under "tail"); a tee'd run is the line
-        # itself — accept both shapes
+        # itself — accept both shapes.  Any OTHER shape (valid JSON
+        # that isn't the expected dict-of-dicts) counts as unreadable:
+        # a malformed artifact next to bench.py must never crash the
+        # run after the chip time is already spent.
+        if not isinstance(doc, dict):
+            raise ValueError(f"expected object, got {type(doc).__name__}")
         if "configs" not in doc and isinstance(doc.get("parsed"), dict):
             doc = doc["parsed"]
-        prior = doc.get("configs", {})
-    except (OSError, ValueError) as e:
+        prior = doc.get("configs")
+        if not isinstance(prior, dict):
+            raise ValueError("no configs map")
+    except (OSError, ValueError, TypeError) as e:
         return {"baseline": prior_path, "ok": True,
                 "error": f"baseline unreadable: {e}"}
     deltas, regressions, uncompared = {}, [], []

@@ -141,3 +141,46 @@ def test_model_family_o1_forward_is_policy_clean(family):
     fn, args = policy_audit.CASES[family]()
     rep = amp.audit(fn, *args)
     assert rep["ok"], (family, rep["violations"])
+
+
+def test_region_form_reduce_is_flagged():
+    """The generic (multi-result / custom-reducer) reduce prints its
+    header without an ``applies`` clause — the adds live in a reducer
+    REGION.  A bf16 accumulation in that form must still be flagged."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def escaped(x):
+        s, p = lax.reduce((x, x), (jnp.bfloat16(0), jnp.bfloat16(1)),
+                          lambda a, b: (a[0] + b[0], a[1] * b[1]), (0,))
+        return s.astype(jnp.float32).sum() + p.astype(jnp.float32).sum()
+
+    rep = amp.audit(escaped, jnp.ones((8, 4), jnp.bfloat16))
+    assert not rep["ok"]
+    assert any(v["op"] == "reduce" and v["dtype"] == "bf16"
+               for v in rep["violations"])
+
+
+def test_region_form_max_reduce_is_clean():
+    # an exact (max) reducer region must not trip the accumulation flag
+    txt = """
+    %0 = stablehlo.reduce(%arg0 init: %cst) across dimensions = [0] : (tensor<8x4xbf16>, tensor<bf16>) -> tensor<4xbf16>
+     reducer(%a: tensor<bf16>, %b: tensor<bf16>) {
+      %1 = stablehlo.maximum %a, %b : tensor<bf16>
+      stablehlo.return %1 : tensor<bf16>
+    }
+    """
+    assert amp.audit_text(txt)["ok"]
+
+
+def test_ops_after_reducer_region_not_misattributed():
+    # an add AFTER the region closes is a plain add, not an accumulation
+    txt = """
+    %0 = stablehlo.reduce(%arg0 init: %cst) across dimensions = [0] : (tensor<8x4xbf16>, tensor<bf16>) -> tensor<4xbf16>
+     reducer(%a: tensor<bf16>, %b: tensor<bf16>) {
+      %1 = stablehlo.maximum %a, %b : tensor<bf16>
+      stablehlo.return %1 : tensor<bf16>
+    }
+    %2 = stablehlo.add %x, %y : tensor<4xbf16>
+    """
+    assert amp.audit_text(txt)["ok"]

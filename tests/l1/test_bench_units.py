@@ -176,3 +176,13 @@ def test_compare_configs_lists_prior_only_and_ungated(tmp_path):
     assert verdict["ok"]
     assert "resnet50_o2_hoststream" in verdict["uncompared"]
     assert "deleted_config" in verdict["uncompared"]  # baseline-only
+
+
+def test_compare_configs_wrong_shape_baselines_never_crash(tmp_path):
+    import json
+    for i, payload in enumerate(
+            ('{"configs": null}', "[1, 2, 3]", '{"parsed": 7}', "3")):
+        p = tmp_path / f"BENCH_r9{i}.json"
+        p.write_text(payload)
+        verdict = bench.compare_configs(str(p), {"a": {"img_s": 1.0}})
+        assert verdict["ok"] and "error" in verdict, payload
