@@ -291,18 +291,35 @@ def attention(
         # Head-major fast path (see flash_attention): local only — the
         # sequence-parallel engines speak (B, L, H, D).
         raise ValueError("layout='bhld' requires axis_name=None")
+    rope = kwargs.pop("rope", None)
+    if rope is not None and axis_name is not None:
+        # The sequence-parallel engines take pre-rotated q/k (positions
+        # are global, each rank rotates its shard before the collective).
+        raise ValueError("rope=(cos, sin) requires axis_name=None; "
+                         "rotate q/k with apply_rope before a "
+                         "sequence-parallel call")
     if axis_name is None:
         if impl == "flash" or (impl != "jnp" and _use_pallas_blocks()):
             from apex_tpu.ops.pallas.flash_attention import flash_attention
             return flash_attention(q, k, v, layout=layout,
                                    causal=kwargs.get("causal", False),
                                    kv_mask=kwargs.get("kv_mask"),
-                                   scale=kwargs.get("scale"))
+                                   scale=kwargs.get("scale"),
+                                   block_q=kwargs.get("block_q"),
+                                   block_k=kwargs.get("block_k"),
+                                   return_lse=kwargs.get("return_lse",
+                                                         False),
+                                   rope=rope)
+        if rope is not None:
+            from apex_tpu.ops.rope import apply_rope_tables
+            q, k = apply_rope_tables(q, k, rope, layout)
         if layout == "bhld":
             # jnp fallback speaks (B, L, H, D)
             out = attention(jnp.moveaxis(q, 1, 2), jnp.moveaxis(k, 1, 2),
                             jnp.moveaxis(v, 1, 2), axis_name=None,
                             impl=impl, **kwargs)
+            if kwargs.get("return_lse", False):
+                return jnp.moveaxis(out[0], 1, 2), out[1]
             return jnp.moveaxis(out, 1, 2)
         s = _block_scores(q, k, kwargs.get("scale") or 1.0 / (q.shape[-1] ** 0.5),
                           0, 0, kwargs.get("causal", False),
@@ -311,8 +328,15 @@ def attention(
         p = jnp.exp(s - m)
         l = p.sum(axis=-1, keepdims=True)
         safe_l = jnp.where(l == 0.0, 1.0, l)
-        return jnp.einsum("bhqk,bkhd->bqhd", p / safe_l,
-                          v.astype(jnp.float32)).astype(q.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p / safe_l,
+                         v.astype(jnp.float32)).astype(q.dtype)
+        if kwargs.get("return_lse", False):
+            # (B, L, H) fp32, NEG_INF for fully-masked rows — the flash
+            # branch's convention, so the two backends interchange.
+            lse = jnp.where(l[..., 0] == 0.0, NEG_INF,
+                            m[..., 0] + jnp.log(safe_l[..., 0]))
+            return out, jnp.moveaxis(lse, 1, 2)
+        return out
     if impl == "ulysses":
         return ulysses_attention(q, k, v, axis_name, **kwargs)
     if impl in ("flash", "jnp"):

@@ -27,6 +27,15 @@ import flax.linen as nn
 
 from apex_tpu.layers import Dense
 from apex_tpu.normalization import FusedLayerNorm
+# Rope math lives in ops (the flash kernel applies it in-kernel); the
+# historical spellings stay importable from here.
+from apex_tpu.ops.rope import (  # noqa: F401  (re-exports)
+    apply_rope,
+    apply_rope_mxu,
+    rope,
+    rope_tables,
+    _rope_rot_matrix,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,70 +82,6 @@ def gpt_tiny() -> GPTConfig:
                      num_heads=4, intermediate_size=128)
 
 
-def rope_tables(positions: jax.Array, head_dim: int,
-                theta: float) -> tuple:
-    """(cos, sin) rotation tables ``(B, L, 1, head_dim//2)`` from *global*
-    position indices — computed once per step and shared by q and k across
-    every layer (they depend only on positions), so the transcendentals
-    stay out of the scanned/remat layer body."""
-    half = head_dim // 2
-    freqs = jnp.exp(-jnp.log(theta)
-                    * jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = positions[:, :, None].astype(jnp.float32) * freqs  # (B, L, half)
-    return jnp.cos(angles)[:, :, None, :], jnp.sin(angles)[:, :, None, :]
-
-
-def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
-    """Rotate ``(B, L, H, D)`` by precomputed tables."""
-    half = x.shape[-1] // 2
-    x1 = x[..., :half].astype(jnp.float32)
-    x2 = x[..., half:].astype(jnp.float32)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
-                          axis=-1)
-    return out.astype(x.dtype)
-
-
-def _rope_rot_matrix(d: int) -> jax.Array:
-    """Constant (D, D) matrix with ``x @ R == rotate_half(x)`` (i.e.
-    ``concat(-x2, x1)``).  Entries are 0/±1, exact in bf16."""
-    half = d // 2
-    i = jnp.arange(half)
-    r = jnp.zeros((d, d), jnp.float32)
-    r = r.at[half + i, i].set(-1.0)
-    r = r.at[i, half + i].set(1.0)
-    return r
-
-
-def apply_rope_mxu(x: jax.Array, cos_full: jax.Array,
-                   sin_full: jax.Array) -> jax.Array:
-    """Rotary embedding with the half-rotation as an MXU matmul.
-
-    The concat-of-half-slices spelling (:func:`apply_rope`) creates
-    minor-dim-32 lane slices whose fwd+bwd materialize as copies in the
-    head-major layout (round-3 profile: 48 copies + fp32 backward
-    copies per step).  ``x @ R`` with a constant 0/±1 matrix is the
-    same permutation on the MXU — layout-neutral, exact, and its
-    transpose is again a single matmul.  Tables are full-width:
-    ``cos_full = concat(cos, cos)``, ``sin_full = concat(sin, sin)``.
-    """
-    r = _rope_rot_matrix(x.shape[-1]).astype(x.dtype)
-    # precision="highest": with fp32 inputs the MXU's default bf16
-    # passes would round what must be an exact permutation (0/±1 rows);
-    # bf16 inputs are exact either way, and the matmul is tiny.
-    xr = jnp.matmul(x, r, precision="highest")
-    out = (x.astype(jnp.float32) * cos_full
-           + xr.astype(jnp.float32) * sin_full)
-    return out.astype(x.dtype)
-
-
-def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """One-shot rotary embedding (tables + apply); positions are global
-    indices, so a sequence-sharded rank rotates its local shard
-    correctly."""
-    cos, sin = rope_tables(positions, x.shape[-1], theta)
-    return apply_rope(x, cos, sin)
-
-
 class CausalSelfAttention(nn.Module):
     cfg: GPTConfig
 
@@ -145,28 +90,37 @@ class CausalSelfAttention(nn.Module):
         c = self.cfg
         head_dim = c.hidden_size // c.num_heads
         b, l = x.shape[0], x.shape[1]
-        cos, sin = rope_cs
         scale = 1.0 / float(head_dim) ** 0.5
         from apex_tpu.attention import attention
-        # NB: the head-major fast path (_QKVProj + layout="bhld" +
-        # apply_rope_mxu — see models/bert.py, +3% there) measured a
-        # net -3% HERE: without rope the path saves the relayout
-        # copies, but GPT's rotary step between projection and kernel
-        # re-materializes head-major intermediates that the split
-        # spelling hides inside its (already-paid) relayouts.  The
-        # split path stays until someone fuses rope into the kernel.
+        from apex_tpu.ops.rope import KernelRopeTables
+
         qkv = Dense(3 * c.hidden_size, name="qkv")(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (t.reshape(b, l, c.num_heads, head_dim)
+                   for t in jnp.split(qkv, 3, axis=-1))
 
-        def heads(t):
-            return t.reshape(t.shape[0], t.shape[1], c.num_heads, head_dim)
-
-        q = apply_rope(heads(q), cos, sin)
-        k = apply_rope(heads(k), cos, sin)
-        v = heads(v)
-        # with seq_axis_name: ring attention over the mesh axis
-        out = attention(q, k, v, axis_name=c.seq_axis_name, causal=True,
-                        scale=scale)
+        if isinstance(rope_cs, KernelRopeTables):
+            # Kernel-fused rope (GPTModel builds the kernel-format
+            # tables once per step, outside the scanned/remat body):
+            # q/k reach the flash kernel UNROTATED and the rotation
+            # happens on VMEM blocks right before the score matmul —
+            # the rotated tensors never exist in HBM and the four rope
+            # elementwise passes (q/k fwd, dq/dk bwd) disappear from
+            # the step.  Same-day v5e A/B (round 4, B8·L2048 O2 train
+            # step): split+fused-rope beats the round-3 prerotated path
+            # ~+2% at both 12x64 and 6x128, and beats a head-major
+            # (HeadMajorQKVProj + layout="bhld" + fused rope) variant
+            # by ~5% at 12x64 — unlike BERT, GPT loses more to the
+            # head-major projection einsum than the reshape relayout
+            # costs, so the split spelling stays.
+            out = attention(q, k, v, causal=True, scale=scale,
+                            rope=rope_cs)
+        else:
+            cos, sin = rope_cs
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            # with seq_axis_name: ring attention over the mesh axis
+            out = attention(q, k, v, axis_name=c.seq_axis_name,
+                            causal=True, scale=scale)
         out = out.reshape(b, l, c.hidden_size)
         return Dense(c.hidden_size, name="out")(out)
 
@@ -215,8 +169,20 @@ class GPTModel(nn.Module):
         x = nn.Embed(c.vocab_size, c.hidden_size, name="tok_emb")(input_ids)
         # rope tables depend only on positions: compute once, share across
         # q/k and every layer (kept out of the scanned/remat body)
-        rope_cs = rope_tables(positions, c.hidden_size // c.num_heads,
-                              c.rope_theta)
+        head_dim = c.hidden_size // c.num_heads
+        rope_cs = rope_tables(positions, head_dim, c.rope_theta)
+        from apex_tpu.ops import use_pallas
+        if use_pallas() and c.seq_axis_name is None:
+            # Local flash path: pre-build the KERNEL-format tables here
+            # too (concat/sign-fold/cast), so under scan_layers/remat
+            # the per-layer attention calls reuse them instead of
+            # rebuilding (B, L, D) tables inside the compiled loop body.
+            from apex_tpu.ops.rope import rope_kernel_tables
+            table_dtype = (jnp.bfloat16 if x.dtype == jnp.bfloat16
+                           else jnp.float32)
+            rope_cs = rope_kernel_tables(
+                rope_cs[0], rope_cs[1], B, input_ids.shape[1], head_dim,
+                table_dtype)
         if c.scan_layers:
             body = _ScanBody
             if c.remat:

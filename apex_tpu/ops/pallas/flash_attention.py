@@ -27,6 +27,19 @@ Masking: ``kv_mask`` (key padding) arrives as an additive fp32 bias row
 from block offsets inside the kernel.  Fully-masked query rows produce
 ``l = 0`` and emit zeros (masked-softmax convention, matching
 ``apex_tpu.attention``).
+
+Rotary embeddings (``rope=(cos, sin)``) are applied *inside* the kernel:
+q/k blocks are rotated in VMEM right before the score matmul, the saved
+residuals stay unrotated, and the backward kernels rotate again for the
+probability recompute and inverse-rotate the dq/dk accumulators at emit
+(the rotation is orthogonal, so ``d(unrotated) = R^T · d(rotated)`` is
+the same lane-rotation with the sine negated).  The rotated q/k never
+exist in HBM — this is what lets the head-major GPT path stay a pure
+reshape end to end (round 3 measured the out-of-kernel rotation
+re-materializing the layout, net -3%).  Tables arrive as full-width
+``(B, L, D)`` pairs (see :func:`apex_tpu.ops.rope.rope_kernel_tables`)
+and are held VMEM-resident per batch when they fit
+(``_ROPE_RESIDENT_MAX_BYTES`` per side) or streamed per block above that.
 """
 
 from __future__ import annotations
@@ -51,9 +64,57 @@ _LANES = 128
 _STATS_W = _LANES
 NEG_INF = -1e30
 
+#: Per-side byte budget (cos + sin whole tables) under which the rope
+#: tables ride a single (1, Lp, D) VMEM block per batch — the index map
+#: is constant across the inner grid walk, so Mosaic fetches them once
+#: per batch instead of re-DMAing a (block, D) pair every step (at
+#: d=64/bf16 the per-step table traffic would otherwise double the
+#: k-side stream).  Above the budget (long contexts) the tables stream
+#: per block; those regimes run 1024-wide blocks where compute dominates
+#: the extra DMA.
+_ROPE_RESIDENT_MAX_BYTES = 1 << 20
+
 
 def _ceil_to(x: int, m: int) -> int:
     return -(-x // m) * m
+
+
+def _rot(x, cos, sin):
+    """Rotate a ``(rows, D)`` block in fp32: ``x·cos + rot_half(x)·sin``
+    where ``rot_half`` maps lane ``j`` to ``x[(j + D/2) mod D]`` and the
+    tables arrive pre-signed (``sin = [-sin, sin]`` — see
+    :func:`apex_tpu.ops.rope.rope_kernel_tables`); the inverse rotation
+    is the same call with ``-sin``.  The lane rotation is spelled as a
+    two-slice concat, VMEM-local in Mosaic."""
+    half = x.shape[-1] // 2
+    xr = jnp.concatenate([x[:, half:], x[:, :half]], axis=1)
+    return (x.astype(jnp.float32) * cos.astype(jnp.float32)
+            + xr.astype(jnp.float32) * sin.astype(jnp.float32))
+
+
+def _rope_nrefs(rope_mode) -> int:
+    """How many rope refs a kernel receives for this mode."""
+    return {None: 0, "resident": 2, "stream": 4}[rope_mode]
+
+
+def _rope_q(rope_refs, rope_mode, q_start, block_q):
+    """(cos, sin) for the current q block.  Resident mode slices the
+    whole-(Lp, D) tables held in VMEM (block starts are multiples of the
+    8-sublane granularity, so the dynamic slice is layout-aligned);
+    stream mode reads the per-block pipelined refs."""
+    if rope_mode == "resident":
+        cos_ref, sin_ref = rope_refs
+        return (cos_ref[0, pl.ds(q_start, block_q), :],
+                sin_ref[0, pl.ds(q_start, block_q), :])
+    return rope_refs[0][0], rope_refs[1][0]
+
+
+def _rope_k(rope_refs, rope_mode, k_start, block_k):
+    if rope_mode == "resident":
+        cos_ref, sin_ref = rope_refs
+        return (cos_ref[0, pl.ds(k_start, block_k), :],
+                sin_ref[0, pl.ds(k_start, block_k), :])
+    return rope_refs[2][0], rope_refs[3][0]
 
 
 def _causal_mask(bq, bk, q_start, k_start):
@@ -78,9 +139,10 @@ def _causal_dispatch(causal, live, straddle, update, dead=None):
         update(False)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, causal, has_bias,
-                block_q, block_k, nk):
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, *rest, causal, has_bias,
+                rope_mode, block_q, block_k, nk):
+    rope_refs = rest[:_rope_nrefs(rope_mode)]
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = rest[_rope_nrefs(rope_mode):]
     ik = pl.program_id(2)
     iq = pl.program_id(1)
 
@@ -109,6 +171,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         # pass instead of an (L, L) one here).
         q = q_ref[0]                              # (bq, d)
         k = k_ref[0]                              # (bk, d)
+        if rope_mode:
+            cq, sq = _rope_q(rope_refs, rope_mode, q_start, block_q)
+            ck, sk = _rope_k(rope_refs, rope_mode, k_start, block_k)
+            q = _rot(q, cq, sq).astype(q_ref.dtype)
+            k = _rot(k, ck, sk).astype(k_ref.dtype)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)             # (bq, bk)
@@ -185,7 +252,9 @@ def _bwd_p(q, k, bias_row, lse_col, *, masked, has_bias, q_start, k_start,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
-               dq_ref, dq_scr, *, causal, has_bias, block_q, block_k, nk):
+               *rest, causal, has_bias, rope_mode, block_q, block_k, nk):
+    rope_refs = rest[:_rope_nrefs(rope_mode)]
+    dq_ref, dq_scr = rest[_rope_nrefs(rope_mode):]
     ik = pl.program_id(2)
     iq = pl.program_id(1)
 
@@ -201,6 +270,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
     def _update(masked):
         q = q_ref[0]
         k = k_ref[0]
+        if rope_mode:
+            cq, sq = _rope_q(rope_refs, rope_mode, q_start, block_q)
+            ck, sk = _rope_k(rope_refs, rope_mode, k_start, block_k)
+            q = _rot(q, cq, sq).astype(q_ref.dtype)
+            k = _rot(k, ck, sk).astype(k_ref.dtype)
         p = _bwd_p(q, k, bias_ref[0], lse_ref[0][:, :1], masked=masked,
                    has_bias=has_bias, q_start=q_start, k_start=k_start,
                    block_q=block_q, block_k=block_k)
@@ -221,12 +295,20 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
 
     @pl.when(ik == nk - 1)
     def _emit():
-        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+        dq = dq_scr[...]
+        if rope_mode:
+            # The accumulated dq is w.r.t. the ROTATED q; chain through
+            # the orthogonal rotation: R^T = the same lane-rotation with
+            # the sine negated.
+            cq, sq = _rope_q(rope_refs, rope_mode, q_start, block_q)
+            dq = _rot(dq, cq, -sq)
+        dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr, *, causal, has_bias,
-                block_q, block_k, nq):
+                *rest, causal, has_bias, rope_mode, block_q, block_k, nq):
+    rope_refs = rest[:_rope_nrefs(rope_mode)]
+    dk_ref, dv_ref, dk_scr, dv_scr = rest[_rope_nrefs(rope_mode):]
     iq = pl.program_id(2)
     ik = pl.program_id(1)
 
@@ -243,6 +325,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
     def _update(masked):
         q = q_ref[0]
         k = k_ref[0]
+        if rope_mode:
+            cq, sq = _rope_q(rope_refs, rope_mode, q_start, block_q)
+            ck, sk = _rope_k(rope_refs, rope_mode, k_start, block_k)
+            q = _rot(q, cq, sq).astype(q_ref.dtype)
+            k = _rot(k, ck, sk).astype(k_ref.dtype)
         p = _bwd_p(q, k, bias_ref[0], lse_ref[0][:, :1], masked=masked,
                    has_bias=has_bias, q_start=q_start, k_start=k_start,
                    block_q=block_q, block_k=block_k)
@@ -264,13 +351,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
 
     @pl.when(iq == nq - 1)
     def _emit():
-        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dk = dk_scr[...]
+        if rope_mode:
+            ck, sk = _rope_k(rope_refs, rope_mode, k_start, block_k)
+            dk = _rot(dk, ck, -sk)
+        dk_ref[0] = dk.astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      bias_ref, dqp_ref, dk_ref, dv_ref, dk_scr, dv_scr,
-                      *, causal, has_bias, block_q, block_k, nq):
+                      bias_ref, *rest, causal, has_bias, rope_mode,
+                      block_q, block_k, nq):
     """One-pass backward: p/dp are computed once per block pair and feed
     dq, dk and dv together (the two-pass kernels recompute them, costing
     an extra score matmul + exp per pair).  Grid (bh, ik, iq): dk/dv
@@ -278,6 +369,8 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     indexed by iq), so each k block writes its dq contribution to its
     own fp32 partial plane, summed by XLA outside — O(nk) extra HBM, so
     the caller only picks this kernel when nk is small."""
+    rope_refs = rest[:_rope_nrefs(rope_mode)]
+    dqp_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest[_rope_nrefs(rope_mode):]
     iq = pl.program_id(2)
     ik = pl.program_id(1)
 
@@ -294,6 +387,11 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _update(masked):
         q = q_ref[0]
         k = k_ref[0]
+        if rope_mode:
+            cq, sq = _rope_q(rope_refs, rope_mode, q_start, block_q)
+            ck, sk = _rope_k(rope_refs, rope_mode, k_start, block_k)
+            q = _rot(q, cq, sq).astype(q_ref.dtype)
+            k = _rot(k, ck, sk).astype(k_ref.dtype)
         p = _bwd_p(q, k, bias_ref[0], lse_ref[0][:, :1], masked=masked,
                    has_bias=has_bias, q_start=q_start, k_start=k_start,
                    block_q=block_q, block_k=block_k)
@@ -309,9 +407,15 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[...] += jax.lax.dot_general(
             ds_c, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dqp_ref[0, 0] = jax.lax.dot_general(
+        dqp = jax.lax.dot_general(
             ds_c, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # (bq, d) fp32
+        if rope_mode:
+            # Rotation is linear, so inverse-rotating each partial plane
+            # equals inverse-rotating their sum (done outside otherwise).
+            cq, sq = _rope_q(rope_refs, rope_mode, q_start, block_q)
+            dqp = _rot(dqp, cq, -sq)
+        dqp_ref[0, 0] = dqp
 
     def _zero_dead():
         # Dead blocks still own their dq partial plane slot: zero it.
@@ -321,8 +425,36 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(iq == nq - 1)
     def _emit():
-        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dk = dk_scr[...]
+        if rope_mode:
+            ck, sk = _rope_k(rope_refs, rope_mode, k_start, block_k)
+            dk = _rot(dk, ck, -sk)
+        dk_ref[0] = dk.astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _rope_inputs(cos_t, sin_t, rope_mode, h, lp, d, block_q, block_k,
+                 q_pos, k_pos):
+    """(operands, in_specs) for the rope tables of one pallas_call.
+    ``q_pos``/``k_pos`` say which grid axis (1 or 2) carries the q/k
+    block index in the calling kernel's grid order.  Resident mode: one
+    whole-``(Lp, D)`` block per table with a constant index map — Mosaic
+    fetches it once per batch and the kernel slices per block.  Stream
+    mode: per-block pipelined (cos_q, sin_q, cos_k, sin_k)."""
+    if not rope_mode:
+        return [], []
+    if rope_mode == "resident":
+        spec = pl.BlockSpec((1, lp, d), lambda g0, g1, g2: (g0 // h, 0, 0))
+        return [cos_t, sin_t], [spec, spec]
+
+    def _m(pos):
+        if pos == 1:
+            return lambda g0, g1, g2: (g0 // h, g1, 0)
+        return lambda g0, g1, g2: (g0 // h, g2, 0)
+
+    qspec = pl.BlockSpec((1, block_q, d), _m(q_pos))
+    kspec = pl.BlockSpec((1, block_k, d), _m(k_pos))
+    return [cos_t, sin_t, cos_t, sin_t], [qspec, qspec, kspec, kspec]
 
 
 def _delta(of, do_f, dlse_f):
@@ -340,19 +472,22 @@ def _delta(of, do_f, dlse_f):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("causal", "has_bias", "block_q",
-                                    "block_k", "num_heads"))
-def _flash_bwd_fused(qf, kf, vf, of, do_f, lse, bias, dlse_f, *, causal,
-                     has_bias, block_q, block_k, num_heads):
+                   static_argnames=("causal", "has_bias", "rope_mode",
+                                    "block_q", "block_k", "num_heads"))
+def _flash_bwd_fused(qf, kf, vf, of, do_f, lse, bias, cos_t, sin_t, dlse_f,
+                     *, causal, has_bias, rope_mode, block_q, block_k,
+                     num_heads):
     bh, lp, d = qf.shape
     nq, nk = lp // block_q, lp // block_k
     h = num_heads
     delta = _delta(of, do_f, dlse_f)
+    rope_ops, rope_specs = _rope_inputs(cos_t, sin_t, rope_mode, h, lp, d,
+                                        block_q, block_k, q_pos=2, k_pos=1)
 
     dq_part, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, causal=causal,
-                          has_bias=has_bias, block_q=block_q,
-                          block_k=block_k, nq=nq),
+                          has_bias=has_bias, rope_mode=rope_mode,
+                          block_q=block_q, block_k=block_k, nq=nq),
         grid=(bh, nk, nq),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh_, ik, iq: (bh_, iq, 0)),
@@ -365,7 +500,7 @@ def _flash_bwd_fused(qf, kf, vf, of, do_f, lse, bias, dlse_f, *, causal,
                          lambda bh_, ik, iq: (bh_, iq, 0)),
             pl.BlockSpec((1, 1, block_k),
                          lambda bh_, ik, iq: (bh_ // h, 0, ik)),
-        ],
+        ] + rope_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bh_, ik, iq: (ik, bh_, iq, 0)),
@@ -380,7 +515,7 @@ def _flash_bwd_fused(qf, kf, vf, of, do_f, lse, bias, dlse_f, *, causal,
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=not on_tpu(),
-    )(qf, kf, vf, do_f, lse, delta, bias)
+    )(qf, kf, vf, do_f, lse, delta, bias, *rope_ops)
     dq = dq_part.sum(axis=0).astype(qf.dtype)
     return dq, dk, dv
 
@@ -447,18 +582,21 @@ def _unprep(t, b, l, h, d, layout="blhd"):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("causal", "has_bias", "block_q",
-                                    "block_k", "num_heads"))
-def _flash_fwd(qf, kf, vf, bias, *, causal, has_bias, block_q, block_k,
-               num_heads):
+                   static_argnames=("causal", "has_bias", "rope_mode",
+                                    "block_q", "block_k", "num_heads"))
+def _flash_fwd(qf, kf, vf, bias, cos_t, sin_t, *, causal, has_bias,
+               rope_mode, block_q, block_k, num_heads):
     bh, lp, d = qf.shape
     nq, nk = lp // block_q, lp // block_k
     grid = (bh, nq, nk)
     h = num_heads
+    rope_ops, rope_specs = _rope_inputs(cos_t, sin_t, rope_mode, h, lp, d,
+                                        block_q, block_k, q_pos=1, k_pos=2)
 
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, has_bias=has_bias,
-                          block_q=block_q, block_k=block_k, nk=nk),
+                          rope_mode=rope_mode, block_q=block_q,
+                          block_k=block_k, nk=nk),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh_, iq, ik: (bh_, iq, 0)),
@@ -466,7 +604,7 @@ def _flash_fwd(qf, kf, vf, bias, *, causal, has_bias, block_q, block_k,
             pl.BlockSpec((1, block_k, d), lambda bh_, iq, ik: (bh_, ik, 0)),
             pl.BlockSpec((1, 1, block_k),
                          lambda bh_, iq, ik: (bh_ // h, 0, ik)),
-        ],
+        ] + rope_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh_, iq, ik: (bh_, iq, 0)),
             pl.BlockSpec((1, block_q, _STATS_W),
@@ -484,25 +622,32 @@ def _flash_fwd(qf, kf, vf, bias, *, causal, has_bias, block_q, block_k,
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=not on_tpu(),
-    )(qf, kf, vf, bias)
+    )(qf, kf, vf, bias, *rope_ops)
     return o, lse
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("causal", "has_bias", "block_q",
-                                    "block_k", "num_heads"))
-def _flash_bwd(qf, kf, vf, of, do_f, lse, bias, dlse_f, *, causal,
-               has_bias, block_q, block_k, num_heads):
+                   static_argnames=("causal", "has_bias", "rope_mode",
+                                    "block_q", "block_k", "num_heads"))
+def _flash_bwd(qf, kf, vf, of, do_f, lse, bias, cos_t, sin_t, dlse_f, *,
+               causal, has_bias, rope_mode, block_q, block_k, num_heads):
     bh, lp, d = qf.shape
     nq, nk = lp // block_q, lp // block_k
     h = num_heads
     delta = _delta(of, do_f, dlse_f)
 
     common_in = [qf, kf, vf, do_f, lse, delta, bias]
+    rope_ops_q, rope_specs_q = _rope_inputs(cos_t, sin_t, rope_mode, h, lp,
+                                            d, block_q, block_k,
+                                            q_pos=1, k_pos=2)
+    rope_ops_k, rope_specs_k = _rope_inputs(cos_t, sin_t, rope_mode, h, lp,
+                                            d, block_q, block_k,
+                                            q_pos=2, k_pos=1)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, has_bias=has_bias,
-                          block_q=block_q, block_k=block_k, nk=nk),
+                          rope_mode=rope_mode, block_q=block_q,
+                          block_k=block_k, nk=nk),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh_, iq, ik: (bh_, iq, 0)),
@@ -515,17 +660,18 @@ def _flash_bwd(qf, kf, vf, of, do_f, lse, bias, dlse_f, *, causal,
                          lambda bh_, iq, ik: (bh_, iq, 0)),
             pl.BlockSpec((1, 1, block_k),
                          lambda bh_, iq, ik: (bh_ // h, 0, ik)),
-        ],
+        ] + rope_specs_q,
         out_specs=pl.BlockSpec((1, block_q, d),
                                lambda bh_, iq, ik: (bh_, iq, 0)),
         out_shape=_sds((bh, lp, d), qf.dtype, qf),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=not on_tpu(),
-    )(*common_in)
+    )(*common_in, *rope_ops_q)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, has_bias=has_bias,
-                          block_q=block_q, block_k=block_k, nq=nq),
+                          rope_mode=rope_mode, block_q=block_q,
+                          block_k=block_k, nq=nq),
         grid=(bh, nk, nq),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh_, ik, iq: (bh_, iq, 0)),
@@ -538,7 +684,7 @@ def _flash_bwd(qf, kf, vf, of, do_f, lse, bias, dlse_f, *, causal,
                          lambda bh_, ik, iq: (bh_, iq, 0)),
             pl.BlockSpec((1, 1, block_k),
                          lambda bh_, ik, iq: (bh_ // h, 0, ik)),
-        ],
+        ] + rope_specs_k,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh_, ik, iq: (bh_, ik, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh_, ik, iq: (bh_, ik, 0)),
@@ -550,15 +696,17 @@ def _flash_bwd(qf, kf, vf, of, do_f, lse, bias, dlse_f, *, causal,
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=not on_tpu(),
-    )(*common_in)
+    )(*common_in, *rope_ops_k)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, bias, scale, causal, block_q, block_k, has_bias,
-           layout):
-    (out, lse_pub), _ = _flash_core(q, k, v, bias, scale, causal,
-                                    block_q, block_k, has_bias, layout)
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(6, 7, 8, 9, 10, 11, 12))
+def _flash(q, k, v, bias, cos_t, sin_t, scale, causal, block_q, block_k,
+           has_bias, rope_mode, layout):
+    (out, lse_pub), _ = _flash_core(q, k, v, bias, cos_t, sin_t, scale,
+                                    causal, block_q, block_k, has_bias,
+                                    rope_mode, layout)
     return out, lse_pub
 
 
@@ -567,8 +715,8 @@ def _lse_public(lse, b, l, h):
     return jnp.moveaxis(lse[:, :, 0].reshape(b, h, -1)[:, :, :l], 1, 2)
 
 
-def _flash_core(q, k, v, bias, scale, causal, block_q, block_k, has_bias,
-                layout="blhd"):
+def _flash_core(q, k, v, bias, cos_t, sin_t, scale, causal, block_q,
+                block_k, has_bias, rope_mode, layout="blhd"):
     if layout == "bhld":
         b, h, l, d = q.shape
     else:
@@ -576,25 +724,36 @@ def _flash_core(q, k, v, bias, scale, causal, block_q, block_k, has_bias,
     qf, kf, vf, bias_p, lp = _prep(q, k, v, bias, block_q, block_k, layout)
     # Softmax scale folded into q once ((L, d) elementwise, fused into
     # the prep reshuffle) instead of an (L, L) pass per score block.
+    # Scaling commutes with the in-kernel rotation (both linear), so the
+    # fold stays valid on the rope path.
     qf = qf * jnp.asarray(scale, qf.dtype)
-    of, lse = _flash_fwd(qf, kf, vf, bias_p, causal=causal,
-                         has_bias=has_bias, block_q=block_q,
-                         block_k=block_k, num_heads=h)
+    if rope_mode and cos_t.shape[1] != lp:
+        # Zero-padded tables rotate the (already zero) padded rows to
+        # zero; padded keys are excluded by causality or the pad bias
+        # either way.
+        pad = ((0, 0), (0, lp - cos_t.shape[1]), (0, 0))
+        cos_t = jnp.pad(cos_t, pad)
+        sin_t = jnp.pad(sin_t, pad)
+    of, lse = _flash_fwd(qf, kf, vf, bias_p, cos_t, sin_t, causal=causal,
+                         has_bias=has_bias, rope_mode=rope_mode,
+                         block_q=block_q, block_k=block_k, num_heads=h)
     return ((_unprep(of, b, l, h, d, layout), _lse_public(lse, b, l, h)),
-            (qf, kf, vf, of, lse, bias_p))
+            (qf, kf, vf, of, lse, bias_p, cos_t, sin_t))
 
 
-def _flash_fwd_rule(q, k, v, bias, scale, causal, block_q, block_k,
-                    has_bias, layout):
-    outs, res = _flash_core(q, k, v, bias, scale, causal, block_q,
-                            block_k, has_bias, layout)
-    return outs, (res, q.shape)
+def _flash_fwd_rule(q, k, v, bias, cos_t, sin_t, scale, causal, block_q,
+                    block_k, has_bias, rope_mode, layout):
+    outs, res = _flash_core(q, k, v, bias, cos_t, sin_t, scale, causal,
+                            block_q, block_k, has_bias, rope_mode, layout)
+    # The saved tables are padded to Lp; the cotangents must match the
+    # caller's (unpadded) table shape, so remember it.
+    return outs, (res, q.shape, cos_t.shape)
 
 
-def _flash_bwd_rule(scale, causal, block_q, block_k, has_bias, layout,
-                    saved, cotangents):
+def _flash_bwd_rule(scale, causal, block_q, block_k, has_bias, rope_mode,
+                    layout, saved, cotangents):
     dout, dlse = cotangents
-    (qf, kf, vf, of, lse, bias_p), shape = saved
+    (qf, kf, vf, of, lse, bias_p, cos_t, sin_t), shape, table_shape = saved
     if layout == "bhld":
         b, h, l, d = shape
     else:
@@ -610,15 +769,22 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, has_bias, layout,
     partials_bytes = (lp // block_k) * qf.shape[0] * lp * d * 4
     bwd = (_flash_bwd_fused if partials_bytes <= _fused_bwd_max_bytes()
            else _flash_bwd)
-    dqf, dkf, dvf = bwd(qf, kf, vf, of, do_f, lse, bias_p, dlse_f,
-                        causal=causal, has_bias=has_bias,
-                        block_q=block_q, block_k=block_k, num_heads=h)
+    dqf, dkf, dvf = bwd(qf, kf, vf, of, do_f, lse, bias_p, cos_t, sin_t,
+                        dlse_f, causal=causal, has_bias=has_bias,
+                        rope_mode=rope_mode, block_q=block_q,
+                        block_k=block_k, num_heads=h)
     # The kernels differentiate w.r.t. the pre-scaled q: dk comes out
-    # exact (ds^T @ q_scaled), dq needs the one deferred scale.
+    # exact (ds^T @ q_scaled), dq needs the one deferred scale.  On the
+    # rope path the kernels already inverse-rotated at emit, so dq/dk
+    # are w.r.t. the unrotated inputs here.
     dq = _unprep(dqf, b, l, h, d, layout) * jnp.asarray(scale, dqf.dtype)
     dk = _unprep(dkf, b, l, h, d, layout)
     dv = _unprep(dvf, b, l, h, d, layout)
-    return dq, dk, dv, jnp.zeros((b, l), jnp.float32)
+    # The rope tables are position functions (int positions carry no
+    # gradient); their zero cotangents DCE under jit.
+    return (dq, dk, dv, jnp.zeros((b, l), jnp.float32),
+            jnp.zeros(table_shape, cos_t.dtype),
+            jnp.zeros(table_shape, sin_t.dtype))
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -689,7 +855,7 @@ def _varying(x) -> bool:
 
 def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
                     block_q=None, block_k=None, return_lse=False,
-                    layout="blhd"):
+                    layout="blhd", rope=None):
     """Blockwise exact attention, ``(B, L, H, D)`` convention.
 
     ``layout="bhld"`` instead takes/returns ``(B, H, L, D)`` — the
@@ -697,6 +863,16 @@ def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
     head-major tensors (the relayout to the kernel's row view becomes a
     pure reshape; output and gradients likewise).  The logsumexp stays
     ``(B, L, H)`` in either layout.
+
+    ``rope=(cos, sin)`` (tables from
+    :func:`apex_tpu.ops.rope.rope_tables`, ``(B, L, 1, D/2)`` or
+    ``(B, L, D/2)``) applies the rotary embedding to q and k *inside*
+    the kernel: pass q/k unrotated, the rotation happens on VMEM blocks
+    and the rotated tensors never exist in HBM (gradients are returned
+    w.r.t. the unrotated inputs).  Requires self-attention
+    (``Lq == Lk``).  With bf16 activations the tables are cast to bf16
+    — the extra table rounding is the same class as the bf16 q/k
+    storage itself (the fallback paths rotate in fp32 either way).
 
     Equivalent to the jnp reference path in :mod:`apex_tpu.attention`
     (scores never materialized; fp32 softmax; masked rows emit zeros).
@@ -721,6 +897,10 @@ def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
         scale = 1.0 / (q.shape[-1] ** 0.5)
     seq_ax = 2 if layout == "bhld" else 1
     b, l = q.shape[0], q.shape[seq_ax]
+    d_head = q.shape[-1]
+    if rope is not None and k.shape[seq_ax] != l:
+        raise ValueError("rope requires self-attention (Lq == Lk): q and "
+                         "k share one position table")
     if k.shape[seq_ax] != l or (not on_tpu() and _varying(q)):
         # Cross-attention (blockwise packing needs one shared length) and
         # interpret-mode-under-shard_map (a VMA propagation limitation in
@@ -729,20 +909,35 @@ def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
         if k.shape[seq_ax] != l and return_lse:
             raise ValueError("return_lse requires Lq == Lk (kernel path)")
         if layout == "bhld":
-            out = _jnp_attention(
-                jnp.moveaxis(q, 1, 2), jnp.moveaxis(k, 1, 2),
-                jnp.moveaxis(v, 1, 2), causal=causal, kv_mask=kv_mask,
-                scale=float(scale), return_lse=return_lse)
+            qb, kb, vb = (jnp.moveaxis(t, 1, 2) for t in (q, k, v))
+        else:
+            qb, kb, vb = q, k, v
+        if rope is not None:
+            from apex_tpu.ops.rope import apply_rope_tables
+            qb, kb = apply_rope_tables(qb, kb, rope)
+        out = _jnp_attention(qb, kb, vb, causal=causal, kv_mask=kv_mask,
+                             scale=float(scale), return_lse=return_lse)
+        if layout == "bhld":
             if return_lse:
                 return jnp.moveaxis(out[0], 1, 2), out[1]
             return jnp.moveaxis(out, 1, 2)
-        return _jnp_attention(q, k, v, causal=causal, kv_mask=kv_mask,
-                              scale=float(scale), return_lse=return_lse)
+        return out
     explicit = (block_q, block_k)
     if block_q is None:
         block_q = _default_block(l)
     if block_k is None:
         block_k = _default_block(l)
+    if rope is not None and jnp.dtype(q.dtype).itemsize == 4:
+        # fp32 activations + rope tables: the fused backward at
+        # 1024-blocks already sits near the 16 MB scoped-VMEM cliff in
+        # fp32, and the table blocks push it over (measured: 16.93 MB,
+        # +952 KB over the limit, on the O0 L2048 train step).  Cap the
+        # *defaulted* blocks at 512; explicit requests stay the
+        # caller's choice.
+        if explicit[0] is None:
+            block_q = min(block_q, 512)
+        if explicit[1] is None:
+            block_k = min(block_k, 512)
     block_q = min(block_q, _ceil_to(l, 128))
     block_k = min(block_k, _ceil_to(l, 128))
     # Mosaic tile granularity: the score tile is (block_q, block_k), so
@@ -770,6 +965,29 @@ def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
     # key sits at kpos >= l > qpos for every real row.
     padded = l % math.lcm(int(block_q), int(block_k)) != 0
     has_bias = kv_mask is not None or (padded and not causal)
-    out, lse = _flash(q, k, v, bias, float(scale), bool(causal),
-                      int(block_q), int(block_k), has_bias, layout)
+    rope_mode = None
+    cos_t = sin_t = jnp.zeros((), jnp.float32)   # unused placeholder
+    if rope is not None:
+        from apex_tpu.ops.rope import KernelRopeTables, rope_kernel_tables
+        table_dtype = (jnp.bfloat16 if q.dtype == jnp.bfloat16
+                       else jnp.float32)
+        if isinstance(rope, KernelRopeTables):
+            # Prebuilt kernel-format tables: callers with scanned/remat
+            # layer bodies construct them once per step so the
+            # concat/sign-fold/cast stays out of the compiled layer loop.
+            cos_t = rope.cos_full.astype(table_dtype)
+            sin_t = rope.sin_signed.astype(table_dtype)
+            if cos_t.shape[0] != b:
+                cos_t = jnp.broadcast_to(cos_t, (b,) + cos_t.shape[1:])
+                sin_t = jnp.broadcast_to(sin_t, (b,) + sin_t.shape[1:])
+        else:
+            cos_t, sin_t = rope_kernel_tables(rope[0], rope[1], b, l,
+                                              d_head, table_dtype)
+        lp = _ceil_to(l, math.lcm(int(block_q), int(block_k)))
+        per_side = 2 * lp * d_head * cos_t.dtype.itemsize
+        rope_mode = ("resident"
+                     if per_side <= _ROPE_RESIDENT_MAX_BYTES else "stream")
+    out, lse = _flash(q, k, v, bias, cos_t, sin_t, float(scale),
+                      bool(causal), int(block_q), int(block_k), has_bias,
+                      rope_mode, layout)
     return (out, lse) if return_lse else out
