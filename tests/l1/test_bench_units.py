@@ -79,6 +79,24 @@ def test_compare_configs_flags_only_real_drops(tmp_path):
         "bert_large_lamb_o2", "errored_before", "brand_new_cfg"}
 
 
+def test_compare_configs_skips_batch_mismatch(tmp_path):
+    """An OOM batch-ladder fallback (bench_gpt) changes the tok/s
+    denominator; a config whose batch differs from the baseline's must
+    be listed uncompared, not read as a 50% regression."""
+    prior = _write_bench(tmp_path, "BENCH_r03.json", {
+        "gpt_medium_tpu_o2": {"tok_s": 43500.0, "batch": 8},
+        "gpt_small_o2": {"tok_s": 100000.0, "batch": 8},
+    })
+    verdict = bench.compare_configs(prior, {
+        "gpt_medium_tpu_o2": {"tok_s": 25000.0, "batch": 4,
+                              "oom_fallback_from_batch": 8},
+        "gpt_small_o2": {"tok_s": 99000.0, "batch": 8},
+    }, threshold=0.10)
+    assert verdict["ok"] and not verdict["regressions"]
+    assert "gpt_medium_tpu_o2" in verdict["uncompared"]
+    assert verdict["deltas"].keys() == {"gpt_small_o2"}
+
+
 def test_compare_configs_ok_within_threshold(tmp_path):
     prior = _write_bench(tmp_path, "BENCH_r03.json",
                          {"resnet50_o2": {"img_s": 1000.0}})
