@@ -613,11 +613,6 @@ def main(argv=None):
         record("gpt_small_tpu_heads_L8192_o2", bench_gpt, optional=True,
                tpu_heads=True, remat=True, batch=2, seq=8192, warmup=3,
                iters=15, tiny=False)
-        # bigger matmuls lift MFU: ~368M params, 8x128 heads; OOM
-        # ladder b8->6->4 for low-HBM chip days (round 4)
-        record("gpt_medium_tpu_o2", bench_gpt, optional=True, fresh=True,
-               tpu_heads="medium", batch=8, seq=2048, warmup=3, iters=12,
-               tiny=False, batch_fallbacks=(6, 4))
         # TPU-native input stem (space-to-depth, +8% over conv7+maxpool)
         record("resnet50_s2d_o2", bench_resnet, optional=True,
                opt_level="O2", s2d=True, **rn_args)
@@ -625,6 +620,13 @@ def main(argv=None):
         # the wire, normalize on device, double-buffered H2D)
         record("resnet50_o2_hoststream", bench_resnet, optional=True,
                opt_level="O2", host_stream=True, **rn_args)
+        # bigger matmuls lift MFU: ~368M params, 8x128 heads; OOM
+        # ladder b8->6->4 for low-HBM chip days (round 4) — ordered
+        # late so its worst-case subprocess retries can't starve the
+        # cheaper optional configs of the time budget
+        record("gpt_medium_tpu_o2", bench_gpt, optional=True, fresh=True,
+               tpu_heads="medium", batch=8, seq=2048, warmup=3, iters=12,
+               tiny=False, batch_fallbacks=(6, 4))
         # 16K context, LAST + fresh: the fused one-pass attention
         # backward still runs (805 MB dq partials, under the 1 GiB
         # budget), and clearing caches avoids the HBM-fragmentation

@@ -49,6 +49,71 @@ def _windows(corpus, rng, batch, seq, lo, hi):
         np.stack([corpus[s:s + seq] for s in starts]).astype(np.int32))
 
 
+def corpus_anchors(corpus, split_frac=0.9):
+    """Externally-anchored baselines on the SAME corpus and train/val
+    split the models use, so the convergence targets stop being
+    self-referential (VERDICT r3 weak #4):
+
+    - ``ngram{1,2,3}_nats_per_byte`` — held-out cross-entropy of
+      add-1-smoothed byte n-gram models FIT ON THE TRAIN SPLIT and
+      evaluated on the val split: the classical statistical floors a
+      trained LM must beat to demonstrate it learned more than local
+      byte statistics.
+    - ``gzip/bz2/lzma_nats_per_byte`` — standalone compression of the
+      val split (``len(compressed)·8·ln2 / len(val)``): practical
+      long-range-redundancy references.  Dictionary compressors exploit
+      verbatim long-range matches a short-context LM cannot see, so
+      they bound from a different direction and are reported as
+      context, not as a pass/fail bar.
+
+    All integer counting in numpy; deterministic.
+    """
+    import bz2
+    import gzip
+    import lzma
+
+    split = int(len(corpus) * split_frac)
+    train = np.asarray(corpus[:split], dtype=np.int64)
+    val = np.asarray(corpus[split:], dtype=np.int64)
+    out = {"split_frac": split_frac, "train_bytes": int(train.size),
+           "val_bytes": int(val.size)}
+
+    for k in (1, 2, 3):
+        # counts over train: table of 256^(k-1) contexts x 256
+        # next-bytes, flattened; add-1 smoothing; held-out nats/byte
+
+        def ctx_ids(arr, k=k):
+            """ids of every (k-1)-byte window; m = size - k + 2."""
+            m = arr.size - (k - 1) + 1
+            ids = np.zeros(m, dtype=np.int64)
+            for j in range(k - 1):
+                ids = ids * 256 + arr[j:j + m]
+            return ids
+
+        counts = np.zeros(256 ** k, dtype=np.int64)
+        if k == 1:
+            np.add.at(counts, train, 1)
+            logp = np.log((counts + 1.0) / (counts.sum() + 256.0))
+            nats = float(-logp[val].mean())
+        else:
+            joint = ctx_ids(train)[:-1] * 256 + train[k - 1:]
+            np.add.at(counts, joint, 1)
+            ctx_tot = counts.reshape(-1, 256).sum(axis=1)
+            vctx = ctx_ids(val)[:-1]
+            vj = vctx * 256 + val[k - 1:]
+            c = counts[vj].astype(np.float64)
+            t = ctx_tot[vctx].astype(np.float64)
+            nats = float(-np.log((c + 1.0) / (t + 256.0)).mean())
+        out[f"ngram{k}_nats_per_byte"] = round(nats, 4)
+
+    raw = bytes(bytearray(int(b) & 0xFF for b in val.tolist()))
+    for name, comp in (("gzip", gzip.compress), ("bz2", bz2.compress),
+                       ("lzma", lzma.compress)):
+        nats = len(comp(raw)) * 8.0 * float(np.log(2.0)) / max(len(raw), 1)
+        out[f"{name}_nats_per_byte"] = round(nats, 4)
+    return out
+
+
 def run_gpt_pysrc(steps=600, batch=16, seq=512, hidden=256, layers=4,
                   heads=4, lr=3e-4, target_val_nats=1.75, seed=0,
                   corpus=None):
@@ -294,9 +359,14 @@ def run_dcgan_two_scaler(steps=300, batch=32, image_size=32, zdim=64,
 
 def main():
     out_path = Path(sys.argv[1] if len(sys.argv) > 1
-                    else REPO / "CONVERGENCE_r03.json")
+                    else REPO / "CONVERGENCE_r04.json")
     corpus = _corpus()
     records = {}
+    # Externally-anchored floors on the same corpus/split (VERDICT r3
+    # weak #4): the LM targets must not be self-referential.
+    anchors = corpus_anchors(corpus)
+    records["anchors"] = anchors
+    print(json.dumps({"anchors": anchors}))
     for fn in (lambda: run_gpt_pysrc(corpus=corpus),
                # byte-level MLM learns slower than causal LM: 2400
                # steps (~30 s on chip) to its plateau
@@ -308,8 +378,29 @@ def main():
         rec = fn()
         records[rec["name"]] = rec
         print(json.dumps(rec))
+    # External pass bars: the causal LM must beat the strongest
+    # same-direction statistical floor (add-1 trigram fit on the train
+    # split); the MLM — whose bidirectional conditioning has no causal
+    # n-gram analog — must beat the unigram floor.  Compressors are
+    # context only (verbatim long-range matches, different direction).
+    g = records.get("gpt_pysrc")
+    if g:
+        g["anchor_ngram3_nats"] = anchors["ngram3_nats_per_byte"]
+        g["beats_ngram3"] = bool(
+            g["val_nats_per_byte"] <= anchors["ngram3_nats_per_byte"])
+        g["ok"] = bool(g["ok"] and g["beats_ngram3"])
+    m = records.get("bert_mlm")
+    if m:
+        m["anchor_ngram1_nats"] = anchors["ngram1_nats_per_byte"]
+        key = ("val_mlm_nats" if "val_mlm_nats" in m
+               else "val_nats_per_byte" if "val_nats_per_byte" in m
+               else None)
+        if key:
+            m["beats_ngram1"] = bool(
+                m[key] <= anchors["ngram1_nats_per_byte"])
+            m["ok"] = bool(m["ok"] and m["beats_ngram1"])
     records["platform"] = str(jax.devices()[0])
-    records["all_ok"] = all(r.get("ok") for r in records.values()
+    records["all_ok"] = all(r.get("ok", True) for r in records.values()
                             if isinstance(r, dict))
     out_path.write_text(json.dumps(records, indent=1))
     print(f"wrote {out_path}  all_ok={records['all_ok']}")
