@@ -341,6 +341,33 @@ class TestRopeFused:
         assert seen[-1][:2] == (1024, 1024)
         assert seen[-1][2] is None
 
+    def test_rope_under_shard_map_fallback(self):
+        """Off-TPU, a varying-under-shard_map q routes to the jnp
+        fallback (interpreter VMA limitation); with rope it must rotate
+        out-of-kernel via apply_rope_tables and still match the
+        pre-rotated oracle — the data-parallel GPT step hits exactly
+        this path in the CPU dryruns."""
+        import numpy as onp
+        from jax.sharding import Mesh, PartitionSpec as P
+        devs = jax.devices()[:2]
+        if len(devs) < 2:
+            pytest.skip("needs 2 devices")
+        q, k, v, cos, sin = self._setup(l=256)
+        kw = dict(causal=True, block_q=128, block_k=128)
+        ref = self._oracle(q, k, v, cos, sin, **kw)
+        mesh = Mesh(onp.array(devs), ("data",))
+
+        def fwd(q, k, v, cos, sin):
+            return flash_attention(q, k, v, rope=(cos, sin), **kw)
+
+        out = jax.shard_map(
+            fwd, mesh=mesh,
+            in_specs=(P("data"), P("data"), P("data"), P("data"),
+                      P("data")),
+            out_specs=P("data"))(q, k, v, cos, sin)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
     def test_dispatcher_passthrough_and_seq_parallel_rejection(self):
         from apex_tpu.attention import attention
         q, k, v, cos, sin = self._setup(l=256)
