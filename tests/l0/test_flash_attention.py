@@ -243,10 +243,10 @@ class TestRopeFused:
         out = flash_attention(q, k, v, rope=(cos, sin), **kw)
         ref = self._oracle(q, k, v, cos, sin, **kw)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                                   rtol=2e-5, atol=2e-5)
+                                   rtol=RTOL, atol=ATOL)
         self._check_rope_grads(q, k, v, cos, sin, kw)
 
-    def _check_rope_grads(self, q, k, v, cos, sin, kw, tol=1e-4):
+    def _check_rope_grads(self, q, k, v, cos, sin, kw, tol=GTOL):
         def loss(fn):
             return lambda q, k, v: jnp.sum(
                 jnp.sin(fn(q, k, v)).astype(jnp.float32))
@@ -269,7 +269,7 @@ class TestRopeFused:
         monkeypatch.setattr(fa, "_ROPE_RESIDENT_MAX_BYTES", 0)
         out = flash_attention(q, k, v, rope=(cos, sin), **kw)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                                   rtol=2e-5, atol=2e-5)
+                                   rtol=RTOL, atol=ATOL)
         self._check_rope_grads(q, k, v, cos, sin, kw)
 
     def test_two_pass_backward_matches(self, monkeypatch):
@@ -288,7 +288,7 @@ class TestRopeFused:
         out = flash_attention(qh, kh, vh, layout="bhld", rope=(cos, sin),
                               **kw)
         np.testing.assert_allclose(np.asarray(jnp.moveaxis(out, 1, 2)),
-                                   np.asarray(ref), rtol=2e-5, atol=2e-5)
+                                   np.asarray(ref), rtol=RTOL, atol=ATOL)
 
     def test_odd_length_bf16(self):
         """Sequence padding: zero-padded table rows rotate the (already
@@ -366,7 +366,7 @@ class TestRopeFused:
                       P("data")),
             out_specs=P("data"))(q, k, v, cos, sin)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                                   rtol=1e-4, atol=1e-4)
+                                   rtol=GTOL, atol=GTOL)
 
     def test_dispatcher_passthrough_and_seq_parallel_rejection(self):
         from apex_tpu.attention import attention
@@ -376,12 +376,12 @@ class TestRopeFused:
         out = attention(q, k, v, impl="flash", causal=True,
                         block_q=128, block_k=128, rope=(cos, sin))
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                                   rtol=2e-5, atol=2e-5)
+                                   rtol=RTOL, atol=ATOL)
         # jnp local path rotates out-of-kernel, same convention
         out_jnp = attention(q, k, v, impl="jnp", causal=True,
                             rope=(cos, sin))
         np.testing.assert_allclose(np.asarray(out_jnp), np.asarray(ref),
-                                   rtol=1e-4, atol=1e-4)
+                                   rtol=GTOL, atol=GTOL)
         with pytest.raises(ValueError, match="axis_name"):
             attention(q, k, v, axis_name="seq", rope=(cos, sin))
         # cross-attention + rope raises the same clear error on the jnp
