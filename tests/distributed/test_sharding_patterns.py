@@ -111,3 +111,72 @@ def test_fsdp_zero3_param_and_moment_sharding():
     m1 = state.opt_state.m["w1"]
     assert m1.sharding.spec == P(None, "data")
     _assert_trains(step, state, x, check)
+
+
+def test_3d_composition_matches_single_device():
+    """DP x TP x SP in one step (the ``dp_tp_sp_3d`` dryrun slice) must
+    produce the SAME loss and updated master params as an unsharded
+    single-device step on identical inputs — a stronger check than the
+    dryrun's finite-loss: it catches wrong-axis psums, double-counted
+    loss normalizers, and missing gradient reductions, the exact bug
+    class 3-D composition invites."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices (virtual CPU mesh or a pod slice)")
+    import __graft_entry__ as graft
+
+    devices = jax.devices()[:8]
+    step, args, _check = graft._build_dp_tp_sp(devices)
+    out_sh = step(*args)
+    jax.block_until_ready(out_sh)
+    state_sh, loss_sh = out_sh
+
+    # unsharded replica: same params/inputs (the builder's fixed seeds),
+    # same math with full tensors and local attention
+    from apex_tpu.attention import attention
+    from apex_tpu.ops.rope import rope
+
+    state0, x, positions = args
+    E, nh = 16, 2
+    B, L = x.shape[0], x.shape[1]
+    hd = E // nh
+
+    def loss_un(p, xb, pos):
+        qkv = xb @ p["wqkv"].astype(xb.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(t.shape[0], t.shape[1], nh, hd)
+
+        q = rope(heads(q), pos, 10000.0)
+        k = rope(heads(k), pos, 10000.0)
+        o = attention(q, k, heads(v), axis_name=None, causal=True)
+        x2 = xb + o.reshape(xb.shape) @ p["wo"].astype(xb.dtype)
+        h = jax.nn.relu(x2 @ p["w1"].astype(x2.dtype))
+        y = h @ p["w2"].astype(h.dtype) + x2
+        return jnp.sum(jnp.square(y).astype(jnp.float32)) / y.size
+
+    from apex_tpu.optimizers import FusedAdam
+    a = amp.initialize(optimizer=FusedAdam(lr=1e-3), opt_level="O2",
+                       verbosity=0)
+    # rebuild an identical unsharded state from the same master params
+    state_un = a.init(jax.tree.map(np.asarray, state0.master_params))
+    step_un = jax.jit(amp.make_train_step(a, loss_un))
+    state_un, metrics_un = step_un(state_un, x, positions)
+
+    # bf16 matmuls reassociate across the model/seq shards (fp32
+    # accumulators, psum'd partials), so agreement is at the fp32
+    # round-off of bf16-product sums
+    np.testing.assert_allclose(float(loss_sh), float(metrics_un["loss"]),
+                               rtol=1e-4)
+    # Param agreement: Adam normalizes each element's update to ~lr, so
+    # a NEAR-ZERO gradient element can flip sign under bf16
+    # reassociation noise and land 2*lr away — bound by the step size
+    # (atol 2.5e-3 > 2*lr=2e-3).  A sharding bug (wrong-axis psum,
+    # double-counted normalizer) shifts whole tensors by O(1) and still
+    # fails loudly.
+    for (pa, la), (_pb, lb) in zip(
+            jax.tree_util.tree_leaves_with_path(state_sh.master_params),
+            jax.tree_util.tree_leaves_with_path(state_un.master_params)):
+        np.testing.assert_allclose(
+            np.asarray(la), np.asarray(lb), rtol=2e-3, atol=2.5e-3,
+            err_msg=jax.tree_util.keystr(pa))
