@@ -349,9 +349,11 @@ class TestRopeFused:
         this path in the CPU dryruns."""
         import numpy as onp
         from jax.sharding import Mesh, PartitionSpec as P
-        devs = jax.devices()[:2]
-        if len(devs) < 2:
-            pytest.skip("needs 2 devices")
+        # 2-way data mesh on CPU (8 virtual devices); on the one-chip
+        # TPU a 1-device mesh still compiles flash+rope under shard_map
+        # (the kernel path — hardware coverage the fallback test line
+        # can't get), so the test adapts instead of skipping.
+        devs = jax.devices()[:min(2, len(jax.devices()))]
         q, k, v, cos, sin = self._setup(l=256)
         kw = dict(causal=True, block_q=128, block_k=128)
         ref = self._oracle(q, k, v, cos, sin, **kw)
