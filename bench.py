@@ -216,30 +216,34 @@ def bench_gpt(batch: int, seq: int, warmup: int, iters: int, peak: float,
 
     # OOM batch ladder: the tunneled chip's usable HBM varies by day
     # (round 4: gpt-medium b8 — which fit in round 3 — OOM'd on OLD and
-    # new code alike while a 14 GB probe buffer allocated fine).  After
-    # an OOM the process is poisoned (server-side buffers from the
+    # new code alike while a 14 GB probe buffer allocated fine).  An
+    # OOM poisons the WHOLE process (server-side buffers from the
     # failed execution linger: a b4 run that succeeds from scratch
-    # fails after a b8 OOM in the same process), so fallback attempts
-    # MUST run in fresh subprocesses — see _gpt_subprocess.  A
+    # fails after a b8 OOM in-process, and round 4's pre-flight saw the
+    # ladder's b8 attempt kill the L16384 config that ran later in the
+    # same bench process), so when a ladder is configured EVERY attempt
+    # — including the first — runs in a fresh subprocess; the main
+    # bench process never executes the OOM-prone config at all.  A
     # degraded-batch record notes the fallback; the regression gate
     # skips batch-mismatched configs (tok/s is not comparable).
-    try:
+    if not batch_fallbacks:
         return run_at(batch)
-    except Exception as e:  # noqa: BLE001 - ladder only on OOM
-        if "RESOURCE_EXHAUSTED" not in str(e) or not batch_fallbacks:
-            raise
-        first_err = f"{type(e).__name__}: {e}"[:200]
-    errs = [first_err]
-    for b in batch_fallbacks:
+    errs = []
+    for i, b in enumerate((batch,) + tuple(batch_fallbacks)):
         res, err = _gpt_subprocess(batch=b, seq=seq, warmup=warmup,
                                    iters=iters, peak=peak, tiny=tiny,
                                    tpu_heads=tpu_heads, remat=remat)
         if res is not None:
-            res["oom_fallback_from_batch"] = batch
+            if i > 0:
+                res["oom_fallback_from_batch"] = batch
             return res
-        errs.append(err)
+        errs.append(f"b{b}: {err}")
+        if err and "RESOURCE_EXHAUSTED" not in err \
+                and "timeout" not in err:
+            break   # non-OOM failure: laddering down won't help
     raise RuntimeError(
-        f"gpt OOM ladder exhausted (batches {(batch,) + tuple(batch_fallbacks)}): "
+        f"gpt OOM ladder exhausted "
+        f"(batches {(batch,) + tuple(batch_fallbacks)}): "
         + " | ".join(errs))
 
 
