@@ -20,6 +20,7 @@ from apex_tpu.models.bert import (
     pretraining_loss,
 )
 from apex_tpu.models.dcgan import Discriminator, Generator, gan_losses
+from apex_tpu.models.generate import generate
 from apex_tpu.models.gpt import (
     GPTConfig,
     GPTModel,
@@ -49,4 +50,5 @@ __all__ = [
     "bert_large", "bert_base", "bert_tiny", "pretraining_loss",
     "Generator", "Discriminator", "gan_losses",
     "GPTConfig", "GPTModel", "gpt_small", "gpt_tiny", "lm_loss",
+    "generate",
 ]

@@ -1,0 +1,200 @@
+"""Autoregressive decoding for :class:`~apex_tpu.models.gpt.GPTModel`.
+
+The reference (2019-era apex) has no inference story; an LM family
+without a decode path is incomplete for users, so this adds KV-cached
+generation as a standalone pure function over the TRAINING checkpoint's
+parameter tree — no separate inference model, no weight conversion.
+
+TPU-shaped design:
+
+- **Static shapes end to end**: the cache is allocated at
+  ``prompt_len + max_new_tokens`` up front, the decode loop is a
+  ``lax.scan`` over steps (one compiled step body), and cache writes
+  are ``dynamic_update_slice`` at the carried position — nothing
+  re-traces as the sequence grows (the classic XLA decode recipe).
+- **Layers run under ``lax.scan``** over a stacked parameter tree, so
+  the per-step body compiles once regardless of depth; loop-layout
+  checkpoints (``block_{i}``) are stacked automatically and
+  scan-layout ones (``layers/block``) pass through.
+- **Exact training semantics**: the manual layer math mirrors
+  ``GPTModel.apply`` op for op (fused layer norm fp32 stats, rope with
+  global positions, fp32 softmax, tanh-approximate gelu), asserted to
+  the final logit in ``tests/l1/test_generate.py``.
+
+Greedy (``temperature=0``) or temperature sampling (``rng`` required).
+Prompts are dense ``(B, L)`` token ids (no padding support — batch
+same-length prompts or decode per row).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models.gpt import GPTConfig
+from apex_tpu.normalization.fused_layer_norm import fused_layer_norm_affine
+from apex_tpu.ops.rope import apply_rope, rope_tables
+
+NEG_INF = -1e30
+
+
+def _stack_layer_params(params, num_layers: int):
+    """Loop layout (``block_{i}`` subtrees) → scan layout (one subtree
+    of ``(num_layers, ...)`` leaves); scan layout passes through."""
+    if "layers" in params:
+        return params["layers"]["block"]
+    blocks = [params[f"block_{i}"] for i in range(num_layers)]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+
+
+def _ln(x, p, eps):
+    return fused_layer_norm_affine(x, p["scale"], p["bias"],
+                                   x.shape[-1], eps)
+
+
+def _attn_cached(q, k_cache, v_cache, valid_mask, scale):
+    """fp32-softmax attention of ``q (B, Lq, H, D)`` against the full
+    cache ``(B, M, H, D)`` with an additive validity mask ``(Lq, M)``
+    (True = attend) — the decode analog of the kernel's conventions."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k_cache.astype(jnp.float32)) * scale
+    s = jnp.where(valid_mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p,
+                     v_cache.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _block(x, p, cfg, kc, vc, cos, sin, valid_mask, write_at):
+    """One transformer block over ``x (B, Lq, E)`` with cache update at
+    ``write_at``; mirrors GPTBlock/CausalSelfAttention exactly."""
+    c = cfg
+    head_dim = c.hidden_size // c.num_heads
+    scale = 1.0 / float(head_dim) ** 0.5
+    b, lq = x.shape[0], x.shape[1]
+
+    h = _ln(x, p["ln1"], c.layer_norm_eps)
+    qkv = h @ p["attention"]["qkv"]["kernel"] \
+        + p["attention"]["qkv"]["bias"].astype(h.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, lq, c.num_heads, head_dim)
+    k = k.reshape(b, lq, c.num_heads, head_dim)
+    v = v.reshape(b, lq, c.num_heads, head_dim)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)  # rotated keys cached (standard layout)
+    kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                      (0, write_at, 0, 0))
+    vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                      (0, write_at, 0, 0))
+    o = _attn_cached(q, kc, vc, valid_mask, scale)
+    o = o.reshape(b, lq, c.hidden_size)
+    x = x + (o @ p["attention"]["out"]["kernel"]
+             + p["attention"]["out"]["bias"].astype(o.dtype))
+    h = _ln(x, p["ln2"], c.layer_norm_eps)
+    h = h @ p["ffn_in"]["kernel"] + p["ffn_in"]["bias"].astype(h.dtype)
+    h = jax.nn.gelu(h)  # tanh approximation, as flax nn.gelu in training
+    return (x + (h @ p["ffn_out"]["kernel"]
+                 + p["ffn_out"]["bias"].astype(h.dtype)),
+            kc, vc)
+
+
+def _forward_cached(params, stacked, cfg, ids, kc, vc, start: int):
+    """Embed ``ids (B, Lq)`` at global positions ``start..start+Lq-1``,
+    run all layers with cache writes at ``start``, return final-token
+    logits and updated caches.  ``start`` may be traced (decode) or 0
+    (prefill)."""
+    c = cfg
+    b, lq = ids.shape
+    m = kc.shape[2]
+    head_dim = c.hidden_size // c.num_heads
+    x = params["tok_emb"]["embedding"][ids]
+    positions = start + jnp.arange(lq)[None, :]
+    positions = jnp.broadcast_to(positions, (b, lq))
+    cos, sin = rope_tables(positions, head_dim, c.rope_theta)
+    # rows attend to cache slots <= their own global position
+    qpos = start + jnp.arange(lq)[:, None]
+    valid = jnp.arange(m)[None, :] <= qpos          # (Lq, M)
+
+    def layer(carry, inputs):
+        x = carry
+        p_l, kc_l, vc_l = inputs
+        x, kc_l, vc_l = _block(x, p_l, c, kc_l, vc_l, cos, sin, valid,
+                               write_at=start)
+        return x, (kc_l, vc_l)
+
+    x, (kc, vc) = jax.lax.scan(layer, x, (stacked, kc, vc))
+    x = _ln(x[:, -1:], params["ln_f"], c.layer_norm_eps)
+    logits = x[:, 0] @ params["lm_head"]["kernel"]
+    return logits, kc, vc
+
+
+def generate(params, cfg: GPTConfig, prompt_ids, max_new_tokens: int,
+             temperature: float = 0.0,
+             rng: Optional[jax.Array] = None):
+    """Decode ``max_new_tokens`` tokens after ``prompt_ids (B, L)``.
+
+    Returns ``(B, L + max_new_tokens)`` ids.  ``temperature=0`` is
+    greedy argmax; ``temperature>0`` samples ``softmax(logits/T)`` and
+    requires ``rng`` (temperature is traced, so sweeping/annealing it
+    never recompiles — only the greedy↔sampling mode switch does).
+    Works with loop- and scan-layout checkpoints; loop layouts are
+    stacked to the scan form OUTSIDE the compiled graph on each call —
+    for repeated generation from a big loop-layout checkpoint, pre-pack
+    once with the scan layout (``params["layers"]["block"]``) to skip
+    the per-call copy.
+    """
+    sample = float(temperature) > 0.0
+    if sample and rng is None:
+        raise ValueError("temperature sampling requires rng")
+    stacked = _stack_layer_params(params, cfg.num_layers)
+    top = {k: v for k, v in params.items()
+           if not k.startswith("block_") and k != "layers"}
+    if rng is None:
+        rng = jax.random.PRNGKey(0)  # unused on the greedy path
+    return _generate_impl(top, stacked, prompt_ids,
+                          jnp.float32(temperature), rng, cfg=cfg,
+                          max_new_tokens=int(max_new_tokens),
+                          sample=sample)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens",
+                                             "sample"))
+def _generate_impl(top, stacked, prompt_ids, temperature, rng, *,
+                   cfg: GPTConfig, max_new_tokens: int, sample: bool):
+    c = cfg
+    b, lp = prompt_ids.shape
+    m = lp + max_new_tokens
+    head_dim = c.hidden_size // c.num_heads
+    dtype = top["tok_emb"]["embedding"].dtype
+    kc = jnp.zeros((c.num_layers, b, m, c.num_heads, head_dim), dtype)
+    vc = jnp.zeros_like(kc)
+
+    logits, kc, vc = _forward_cached(top, stacked, c, prompt_ids,
+                                     kc, vc, start=0)
+
+    def pick(logits, key):
+        if sample:
+            return jax.random.categorical(
+                key, logits.astype(jnp.float32) / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    rng, key0 = jax.random.split(rng)
+    first = pick(logits, key0).astype(prompt_ids.dtype)
+
+    def step(carry, key):
+        tok, t, kc, vc = carry
+        logits, kc, vc = _forward_cached(top, stacked, c, tok[:, None],
+                                         kc, vc, start=t)
+        nxt = pick(logits, key).astype(tok.dtype)
+        return (nxt, t + 1, kc, vc), nxt
+
+    keys = jax.random.split(rng, max(max_new_tokens - 1, 1))
+    (_, _, _, _), rest = jax.lax.scan(
+        step, (first, jnp.asarray(lp, jnp.int32), kc, vc),
+        keys[: max_new_tokens - 1])
+    out = jnp.concatenate(
+        [prompt_ids, first[:, None], jnp.moveaxis(rest, 0, 1)], axis=1)
+    return out[:, :m]

@@ -35,6 +35,11 @@ SELECTION = [
     "tests/l0/test_flash_mh.py::test_rope_mxu_matches_concat_spelling",
     "tests/l0/test_flash_mh.py::test_head_major_projections_match_dense_split",
     "tests/l0/test_flash_mh.py::test_mh_forward_matches_reference[True]",
+    # KV-cached generation vs the naive full-forward oracle (the two
+    # cheapest cases: full-file naive recompiles per length are slow
+    # through the remote compile helper)
+    "tests/l1/test_generate.py::test_single_token_decode",
+    "tests/l1/test_generate.py::test_temperature_sampling_deterministic_and_varied",
     "tests/l0/test_conv1x1.py::test_bwd_matches_lax_transpose[2-8-64-256]",
     "tests/l0/test_multi_tensor.py",
     "tests/l0/test_fused_adam.py",
