@@ -200,22 +200,19 @@ def _sample_windows(corpus, rng, b, l):
 
 
 def _greedy_sample(model, state, corpus, l, n_bytes):
-    """Greedy byte-by-byte continuation of a corpus prompt using the fp32
-    master params; the context is the trailing ``l // 2``-byte window
-    (fixed width so the loop reuses one compiled forward)."""
-    import jax
+    """Greedy continuation of a corpus prompt via the KV-cached decoder
+    (:func:`apex_tpu.models.generate`): one compiled prefill + scan —
+    the previous sliding-window loop re-ran a full forward AND paid one
+    host round trip per generated byte."""
     import jax.numpy as jnp
     import numpy as np
 
-    fwd = jax.jit(lambda p, ids: jnp.argmax(
-        model.apply({"params": p}, ids)[:, -1], axis=-1))
+    from apex_tpu.models import generate
+
     window_len = l // 2
-    prompt = corpus[:window_len].astype(np.int32).tolist()
-    toks = list(prompt)
-    for _ in range(n_bytes):
-        window = toks[-window_len:]
-        ids = jnp.asarray(window, jnp.int32)[None, :]
-        toks.append(int(fwd(state.master_params, ids)[0]))
+    prompt = jnp.asarray(corpus[:window_len].astype(np.int32))[None, :]
+    out = generate(state.master_params, model.cfg, prompt, n_bytes)
+    toks = np.asarray(out)[0].tolist()
     # decode prompt and continuation separately so the '|' separator
     # stays exact even when the byte boundary splits a UTF-8 sequence
     head = bytes(toks[:window_len]).decode("utf-8", errors="replace")
