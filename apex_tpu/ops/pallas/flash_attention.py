@@ -869,10 +869,14 @@ def flash_attention(q, k, v, *, causal=False, kv_mask=None, scale=None,
     ``(B, L, D/2)``) applies the rotary embedding to q and k *inside*
     the kernel: pass q/k unrotated, the rotation happens on VMEM blocks
     and the rotated tensors never exist in HBM (gradients are returned
-    w.r.t. the unrotated inputs).  Requires self-attention
-    (``Lq == Lk``).  With bf16 activations the tables are cast to bf16
-    — the extra table rounding is the same class as the bf16 q/k
-    storage itself (the fallback paths rotate in fp32 either way).
+    w.r.t. the unrotated inputs).  The tables themselves are treated as
+    **non-differentiable position constants**: their cotangents are
+    zero, so a learned-rotary variant differentiating through cos/sin
+    would silently get zero table gradients — rotate outside the kernel
+    for that case.  Requires self-attention (``Lq == Lk``).  With bf16
+    activations the tables are cast to bf16 — the extra table rounding
+    is the same class as the bf16 q/k storage itself (the fallback
+    paths rotate in fp32 either way).
 
     Equivalent to the jnp reference path in :mod:`apex_tpu.attention`
     (scores never materialized; fp32 softmax; masked rows emit zeros).

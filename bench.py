@@ -239,6 +239,7 @@ def bench_gpt(batch: int, seq: int, warmup: int, iters: int, peak: float,
             return res
         errs.append(f"b{b}: {err}")
         if err and "RESOURCE_EXHAUSTED" not in err \
+                and "Out of memory" not in err \
                 and "timeout" not in err:
             break   # non-OOM failure: laddering down won't help
     raise RuntimeError(
@@ -272,8 +273,18 @@ def _gpt_subprocess(**kw):
     for line in p.stdout.splitlines():
         if line.startswith("BENCH_SUBPROC_JSON "):
             return json.loads(line[len("BENCH_SUBPROC_JSON "):]), None
+    blob = (p.stderr or "") + "\n" + (p.stdout or "")
     tail = (p.stderr or p.stdout or "").strip().splitlines()
-    return None, (tail[-1][:200] if tail else f"rc={p.returncode}")
+    msg = tail[-1][:200] if tail else f"rc={p.returncode}"
+    # An OOM's final traceback line often lacks the literal marker
+    # (wrapped XlaRuntimeError tails); surface it from ANYWHERE in the
+    # captured output so the ladder keeps stepping down instead of
+    # misreading the failure as non-OOM.
+    for marker in ("RESOURCE_EXHAUSTED", "Out of memory"):
+        if marker in blob and marker not in msg:
+            msg = f"{marker}: {msg}"
+            break
+    return None, msg
 
 
 #: analytic attention matmul passes per layer.  MODEL passes (the PaLM

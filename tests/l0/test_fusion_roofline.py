@@ -59,6 +59,32 @@ def test_parse_step_tuple_fusion_record():
     assert f["meta"] == "test/convfusion"
 
 
+GRAD_HLO = """\
+HloModule grads
+
+ENTRY %main (p0: bf16[8,16,16,64], p1: bf16[3,3,1,64], p2: bf16[8,14,14,32]) -> f32[3,3,64,32] {
+  %p0 = bf16[8,16,16,64]{3,0,2,1:T(8,128)(2,1)} parameter(0)
+  %p1 = bf16[3,3,1,64]{2,3,1,0:T(8,128)(2,1)} parameter(1)
+  %p2 = bf16[8,14,14,32]{3,0,2,1:T(8,128)(2,1)} parameter(2)
+  %dw.1 = bf16[8,16,16,64]{3,0,2,1:T(8,128)(2,1)} convolution(%p0, %p1), window={size=3x3 pad=1_1x1_1}, dim_labels=b01f_01io->b01f, feature_group_count=64, metadata={op_name="test/depthwise"}
+  %kg.1 = f32[3,3,64,32]{3,2,1,0:T(8,128)} convolution(%p0, %p2), window={size=14x14}, dim_labels=f01b_i01o->01bf, metadata={op_name="test/kernelgrad"}
+  ROOT %out.1 = f32[3,3,64,32]{3,2,1,0:T(8,128)} copy(%kg.1)
+}
+"""
+
+
+def test_conv_flops_contract_over_rhs_i_dim():
+    rec = parse_step(GRAD_HLO)
+    # depthwise (feature_group_count=64): per-output contraction is the
+    # rhs i dim = 1, NOT the lhs f dim = 64 — reading lhs f overcounts
+    # by the group count
+    assert rec["dw.1"]["conv_flops"] == 2.0 * (8 * 16 * 16 * 64) * 9 * 1
+    # kernel-grad conv (labels f01b_i01o): contraction is over batch,
+    # surfaced as the rhs i dim = 8
+    assert (rec["kg.1"]["conv_flops"]
+            == 2.0 * (3 * 3 * 64 * 32) * (14 * 14) * 8)
+
+
 def test_parse_step_duplicate_operands_counted_once():
     rec = parse_step(HLO)
     add = rec["dup.1"]

@@ -64,13 +64,21 @@ def parse_hlo(hlo: str):
             comp_shapes[cur][im.group(1)] = _dims(im.group(2))
         cm = _CONV_RE.search(line)
         if cm and im:
-            lhs, _rhs, window, dim_labels, op_name = cm.groups()
+            lhs, rhs, window, dim_labels, op_name = cm.groups()
             out = _dims(im.group(2))
             lhs_dims = comp_shapes[cur].get(lhs)
             if out is None or lhs_dims is None:
                 continue
-            lhs_label = dim_labels.split("_")[0]
-            cin = lhs_dims[lhs_label.index("f")]
+            # Per-output contraction = rhs "i" dim (robust to grouped/
+            # depthwise convs, where the lhs "f" dim overcounts by the
+            # group count — same rule as fusion_roofline._conv_flops_in)
+            rhs_dims = comp_shapes[cur].get(rhs)
+            rhs_label = dim_labels.split("_")[1].split("->")[0]
+            if rhs_dims is not None and "i" in rhs_label:
+                cin = rhs_dims[rhs_label.index("i")]
+            else:
+                lhs_label = dim_labels.split("_")[0]
+                cin = lhs_dims[lhs_label.index("f")]
             win = 1
             for w in window.split("x"):
                 win *= int(w)

@@ -417,8 +417,13 @@ def main():
                 m[key] <= anchors["ngram1_nats_per_byte"])
             m["ok"] = bool(m["ok"] and m["beats_ngram1"])
     records["platform"] = str(jax.devices()[0])
-    records["all_ok"] = all(r.get("ok", True) for r in records.values()
-                            if isinstance(r, dict))
+    # "anchors" is the only record without a pass/fail of its own; any
+    # OTHER dict missing "ok" is a bug and must fail the aggregate —
+    # not silently count as passing, and not KeyError away the whole
+    # run's results before they're written.
+    records["all_ok"] = all(r.get("ok", False)
+                            for name, r in records.items()
+                            if isinstance(r, dict) and name != "anchors")
     out_path.write_text(json.dumps(records, indent=1))
     print(f"wrote {out_path}  all_ok={records['all_ok']}")
 

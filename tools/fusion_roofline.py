@@ -74,37 +74,52 @@ def _shape_bytes(type_str: str) -> int:
     return total
 
 
-def _conv_flops_in(comp_lines) -> float:
-    """Analytic FLOPs of convolutions inside a computation body (same
-    formula as tools/conv_attrib.py: 2 * prod(out) * prod(window) *
-    C_contract with C_contract read from the lhs ``f`` dim)."""
-    from conv_attrib import parse_hlo  # reuse its regexes via a shim
-    del parse_hlo
+def _conv_flops_in(comp_lines, outer_shapes=None) -> float:
+    """Analytic FLOPs of convolutions inside a computation body:
+    2 * prod(out) * prod(window) * C_contract, with C_contract read
+    from the **rhs ``i`` dim** of ``dim_labels``.  The rhs input-feature
+    size is the per-output-element contraction for every conv variant —
+    plain (i = C_in), grouped/depthwise (i = C_in / groups), and the
+    kernel-gradient convs XLA emits for the backward pass (labels like
+    ``f01b_i01o`` where i = batch); the lhs ``f`` size over-counts the
+    latter two by the group count."""
     total = 0.0
     conv_re = re.compile(
         r"= (\S+) convolution\(%?([\w.\-]+), %?([\w.\-]+)\).*?"
         r"window={size=([0-9x]+)[^}]*}.*?dim_labels=(\S+?)[,}]")
-    shape_of = {}
+    # A bare (unfused) conv arrives as a one-line body whose operands
+    # are defined elsewhere in its computation — resolve through the
+    # caller-supplied scope then.
+    shape_of = dict(outer_shapes or {})
     for raw in comp_lines:
         m = _DEF_RE.match(raw)
         if m:
             shape_of[m.group(1)] = m.group(2).split(" ", 1)[0]
+
+    def _dims(name):
+        sm = _SHAPE_RE.search(shape_of.get(name, "") or "")
+        return ([int(d) for d in sm.group(2).split(",") if d]
+                if sm else [])
+
     for raw in comp_lines:
         m = conv_re.search(raw)
         if not m:
             continue
-        out_t, lhs, _rhs, win, labels = m.groups()
+        out_t, lhs, rhs, win, labels = m.groups()
         out_dims = [int(d) for d in _SHAPE_RE.search(out_t).group(2)
                     .split(",") if d]
         window = [int(w) for w in win.split("x")]
-        lhs_t = shape_of.get(lhs, "")
-        sm = _SHAPE_RE.search(lhs_t or "")
-        lhs_dims = ([int(d) for d in sm.group(2).split(",") if d]
-                    if sm else [])
         lhs_labels = labels.split("_")[0]
-        f_pos = lhs_labels.index("f") if "f" in lhs_labels else -1
-        c_contract = (lhs_dims[f_pos]
-                      if 0 <= f_pos < len(lhs_dims) else 1)
+        rhs_labels = labels.split("_")[1].split("->")[0]
+        rhs_dims = _dims(rhs)
+        i_pos = rhs_labels.index("i") if "i" in rhs_labels else -1
+        if 0 <= i_pos < len(rhs_dims):
+            c_contract = rhs_dims[i_pos]
+        else:  # fallback: lhs f dim (correct for ungrouped forward convs)
+            lhs_dims = _dims(lhs)
+            f_pos = lhs_labels.index("f") if "f" in lhs_labels else -1
+            c_contract = (lhs_dims[f_pos]
+                          if 0 <= f_pos < len(lhs_dims) else 1)
         flops = 2.0 * c_contract
         for d in out_dims:
             flops *= d
@@ -115,8 +130,9 @@ def _conv_flops_in(comp_lines) -> float:
 
 
 def parse_step(hlo: str):
-    """-> (records {instr: {read_b, write_b, conv_flops, meta}},
-           computations {name: [lines]})."""
+    """-> records {instr: {read_b, write_b, conv_flops, meta, op}},
+    indexed across every computation in the module (the train-step body
+    lives inside the loss-scale cond, not ENTRY)."""
     lines = hlo.splitlines()
     comps = {}
     comp_order = []
@@ -185,7 +201,7 @@ def parse_step(hlo: str):
             elif "convolution(" in rest:
                 body = [raw]
             if body is not None:
-                conv_flops = _conv_flops_in(body)
+                conv_flops = _conv_flops_in(body, outer_shapes=shape_of)
             meta = ""
             mm = re.search(r'op_name="([^"]+)"', rest)
             if mm:
