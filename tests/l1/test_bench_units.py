@@ -204,3 +204,122 @@ def test_compare_configs_wrong_shape_baselines_never_crash(tmp_path):
         p.write_text(payload)
         verdict = bench.compare_configs(str(p), {"a": {"img_s": 1.0}})
         assert verdict["ok"] and "error" in verdict, payload
+
+
+def test_compare_configs_ladder_substitutes_same_batch(tmp_path):
+    """A batch-mismatched config with a persisted same-batch ladder
+    baseline is gated like-for-like instead of listed uncompared
+    (VERDICT r4 next #4)."""
+    prior = _write_bench(tmp_path, "BENCH_r04.json", {
+        "gpt_medium_tpu_o2": {"tok_s": 43500.0, "batch": 8},
+    })
+    ladder = {"gpt_medium_tpu_o2": {
+        "4": {"tok_s": 50000.0, "batch": 4, "recorded": "2026-08-01"}}}
+    # like-for-like b4-vs-b4: -4% is fine
+    verdict = bench.compare_configs(prior, {
+        "gpt_medium_tpu_o2": {"tok_s": 48000.0, "batch": 4}},
+        threshold=0.10, ladder=ladder)
+    assert verdict["ok"]
+    assert verdict["deltas"]["gpt_medium_tpu_o2"] == -0.04
+    assert verdict["ladder_compared"]["gpt_medium_tpu_o2"]["batch"] == 4
+    # a real 20% drop vs the same-batch ladder rung DOES trip the gate
+    verdict = bench.compare_configs(prior, {
+        "gpt_medium_tpu_o2": {"tok_s": 40000.0, "batch": 4}},
+        threshold=0.10, ladder=ladder)
+    assert verdict["regressions"] == ["gpt_medium_tpu_o2"]
+    # no ladder entry for the batch -> still uncompared, never guessed
+    verdict = bench.compare_configs(prior, {
+        "gpt_medium_tpu_o2": {"tok_s": 40000.0, "batch": 6}},
+        threshold=0.10, ladder=ladder)
+    assert "gpt_medium_tpu_o2" in verdict["uncompared"]
+
+
+def test_compare_configs_ladder_covers_errored_prior(tmp_path):
+    """The OOM scenario the ladder exists for: the prior round's entry
+    ERRORED (or is missing entirely) — the same-batch rung must still
+    gate the config instead of leaving it uncompared."""
+    prior = _write_bench(tmp_path, "BENCH_r04.json", {
+        "gpt_medium_tpu_o2": {"error": "RESOURCE_EXHAUSTED ..."},
+    })
+    ladder = {"gpt_medium_tpu_o2": {
+        "4": {"tok_s": 50000.0, "batch": 4, "recorded": "2026-08-01"}}}
+    verdict = bench.compare_configs(prior, {
+        "gpt_medium_tpu_o2": {"tok_s": 40000.0, "batch": 4}},
+        threshold=0.10, ladder=ladder)
+    assert verdict["regressions"] == ["gpt_medium_tpu_o2"]
+    assert verdict["ladder_compared"]["gpt_medium_tpu_o2"]["batch"] == 4
+    # prior missing the config entirely: same story
+    prior2 = _write_bench(tmp_path, "BENCH_r05.json", {})
+    verdict = bench.compare_configs(prior2, {
+        "gpt_medium_tpu_o2": {"tok_s": 49500.0, "batch": 4}},
+        threshold=0.10, ladder=ladder)
+    assert verdict["ok"]
+    assert verdict["deltas"]["gpt_medium_tpu_o2"] == -0.01
+
+
+def test_ladder_baselines_roundtrip(tmp_path):
+    configs = {
+        "gpt_medium_tpu_o2": {"tok_s": 49000.0, "batch": 4, "mfu": 0.58},
+        "errored": {"error": "OOM"},
+        "no_batch": {"tok_s": 5.0},
+    }
+    bench.update_ladder_baselines(str(tmp_path), configs)
+    doc = bench.load_ladder_baselines(str(tmp_path))
+    assert doc["gpt_medium_tpu_o2"]["4"]["tok_s"] == 49000.0
+    assert "recorded" in doc["gpt_medium_tpu_o2"]["4"]
+    assert "errored" not in doc and "no_batch" not in doc
+    # updating a new rung keeps the old one
+    bench.update_ladder_baselines(
+        str(tmp_path), {"gpt_medium_tpu_o2": {"tok_s": 44000.0,
+                                              "batch": 8}})
+    doc = bench.load_ladder_baselines(str(tmp_path))
+    assert set(doc["gpt_medium_tpu_o2"]) == {"4", "8"}
+
+
+def test_repo_ladder_has_medium_b4_baseline():
+    # the gate must be able to compare a b4 OOM-ladder landing
+    doc = bench.load_ladder_baselines(str(REPO))
+    assert doc["gpt_medium_tpu_o2"]["4"]["tok_s"] > 0
+
+
+def test_mfu_floor_gate():
+    floors = bench.MFU_FLOORS
+    assert "resnet50_o2" in floors and "gpt_medium_tpu_o2" in floors
+    gate = floors["resnet50_o2"] * (1 - bench.MFU_VARIANCE_BAND)
+    # r4's measured 0.2983 (0.6% under the prose floor, inside chip-day
+    # variance) passes the banded gate — the VERDICT weak-#2 resolution
+    check = bench.check_mfu_floors({"resnet50_o2": {"mfu": 0.2983}})
+    assert check["ok"] and check["checked"]["resnet50_o2"]["ok"]
+    assert check["checked"]["resnet50_o2"]["gate"] == round(gate, 4)
+    # a real efficiency loss does not
+    check = bench.check_mfu_floors({"resnet50_o2": {"mfu": 0.27}})
+    assert not check["ok"] and check["violations"] == ["resnet50_o2"]
+    # errored/skipped/missing configs are not judged
+    check = bench.check_mfu_floors({"resnet50_o2": {"error": "OOM"},
+                                    "gpt_small_o2": {"mfu": None}})
+    assert check["ok"] and not check["checked"]
+
+
+def test_mfu_floors_cover_all_gated_tpu_configs():
+    """Every non-wire-coupled TPU config with an MFU number must carry
+    a published floor — a floor-less config is ungated efficiency."""
+    import json
+    doc = json.load(open(REPO / "BENCH_r04.json"))
+    cfgs = doc.get("parsed", doc)["configs"]
+    for name, rec in cfgs.items():
+        if name in bench.UNGATED_CONFIGS or "mfu" not in rec:
+            continue
+        assert name in bench.MFU_FLOORS, name
+        # floors sit at-or-below the r4 measured value: the gate fires
+        # on future regressions, not retroactively
+        assert bench.MFU_FLOORS[name] * (1 - bench.MFU_VARIANCE_BAND) \
+            <= rec["mfu"], name
+
+
+def test_bench_generate_tiny_cpu():
+    """The decode bench path runs end-to-end on CPU with the tiny
+    config (the real config runs on the driver's chip)."""
+    r = bench.bench_generate(batch=2, prefill=16, new_tokens=8,
+                             warmup=0, iters=1, peak=None, tiny=True)
+    assert r["tok_s"] > 0 and r["batch"] == 2
+    assert r["hbm_tok_s_ceiling"] > 0 and r["prefill"] == 16

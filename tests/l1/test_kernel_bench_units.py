@@ -1,0 +1,69 @@
+"""Units for tools/kernel_bench.py (the microbench itself runs on the
+driver's chip): the per-kernel regression gate and the byte accounting.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO / "tools"))
+
+import kernel_bench as kb  # noqa: E402
+
+
+def _write(tmp_path, kernels):
+    p = tmp_path / "KERNELBENCH_r04.json"
+    p.write_text(json.dumps({"kernels": kernels}))
+    return str(p)
+
+
+def test_compare_kernels_gates_slowdowns_only(tmp_path):
+    prior = _write(tmp_path, {
+        "fused_adam": {"ms_per_step": 1.0},
+        "mt_scale": {"ms_per_step": 0.5},
+        "lamb_stage1": {"ms_per_step": 2.0},
+        "errored_before": {"error": "boom"},
+    })
+    verdict = kb.compare_kernels(prior, {
+        "fused_adam": {"ms_per_step": 1.05},   # +5%: within variance
+        "mt_scale": {"ms_per_step": 0.65},     # +30%: regression
+        "lamb_stage1": {"ms_per_step": 1.2},   # faster: fine
+        "errored_before": {"ms_per_step": 9.0},  # no prior time
+        "brand_new": {"ms_per_step": 1.0},
+    }, threshold=0.10)
+    assert verdict["regressions"] == ["mt_scale"]
+    assert not verdict["ok"]
+    assert verdict["deltas"]["fused_adam"] == 0.05
+    assert verdict["deltas"]["lamb_stage1"] == -0.4
+    assert set(verdict["uncompared"]) == {"errored_before", "brand_new"}
+
+
+def test_compare_kernels_unreadable_baseline_never_fails(tmp_path):
+    bad = tmp_path / "KERNELBENCH_r99.json"
+    bad.write_text("{not json")
+    verdict = kb.compare_kernels(str(bad), {"a": {"ms_per_step": 1.0}})
+    assert verdict["ok"] and "error" in verdict
+
+
+def test_byte_accounting_matches_docstring():
+    n = 1 << 16
+    assert kb.bench_fused_adam(n)[1] == 30.0 * n
+    assert kb.bench_lamb_stage1(n)[1] == 28.0 * n
+    assert kb.bench_lamb_stage2(n)[1] == 14.0 * n
+    assert kb.bench_mt_scale(n)[1] == 8.0 * n
+    assert kb.bench_mt_axpby(n)[1] == 12.0 * n
+    assert kb.bench_mt_sumsq(n)[1] == 4.0 * n
+    rows, hidden = 64, 512
+    assert kb.bench_layernorm_fwd(rows, hidden)[1] == \
+        4.0 * rows * hidden + 8.0 * rows
+
+
+def test_tiny_suite_runs_everywhere():
+    """End-to-end smoke at tiny shapes (interpret mode off-TPU): every
+    kernel produces a timing record, none errors."""
+    result = kb.run_suite(tiny=True)
+    errs = {k: v["error"] for k, v in result["kernels"].items()
+            if "error" in v}
+    assert not errs, errs
+    assert all(v["ms_per_step"] > 0 for v in result["kernels"].values())
