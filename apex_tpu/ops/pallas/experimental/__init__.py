@@ -18,6 +18,12 @@ Current inventory:
   end-to-end in ResNet-50: pulling the conv out of XLA breaks the
   elementwise-into-conv-operand fusions (BN/relu chains) that the
   surrounding graph relies on.
+- :mod:`.finite_pack` — flat-packed gradient finite check.  −1.8 to
+  −3.5% end-to-end vs the per-leaf ``all_finite``: the profiler's 16%
+  "is-finite" bucket is an attribution artifact (XLA fuses the per-leaf
+  reduction into gradient fusions that read the grads anyway; true
+  marginal cost ~2.1%), and the packed path's concat copy fuses into
+  nothing.
 
 Tests for these modules carry the ``experimental`` pytest marker; the
 on-chip suite (``tools/onchip_run.py``) keeps ONE numerics pin per

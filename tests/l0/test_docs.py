@@ -1,7 +1,12 @@
 """Doc-build validation (reference parity: docs/source/conf.py + sphinx
-build).  When sphinx is installed the full ``sphinx-build -W`` runs; the
-structural checks below run everywhere (this environment has no sphinx and
-no pip), so toctree rot and broken autodoc targets fail CI either way.
+build).  This environment has no sphinx and no way to obtain one (no
+egress; ``docutils``/``alabaster``/``imagesize``/``snowballstemmer``
+absent too), so the build check is **never skipped**: when sphinx is
+importable the real ``sphinx-build -W`` runs, otherwise the pinned
+substitute ``tools/rst_check.py`` enforces the same warning classes
+(unknown directives/roles, short title adornments, dead :doc:/include
+targets, unlexable code-block languages, unbalanced literals) — and its
+own detection power is verified here against planted defects.
 """
 
 import importlib
@@ -10,9 +15,9 @@ import subprocess
 import sys
 from pathlib import Path
 
-import pytest
-
-DOCS = Path(__file__).resolve().parents[2] / "docs" / "source"
+REPO = Path(__file__).resolve().parents[2]
+DOCS = REPO / "docs" / "source"
+sys.path.insert(0, str(REPO / "tools"))
 
 
 def test_conf_exists_and_parses():
@@ -59,10 +64,66 @@ def test_crossref_targets_resolve():
         assert obj is not None, f"unresolvable doc reference: {name}"
 
 
-def test_sphinx_build_clean():
-    pytest.importorskip("sphinx")
+def test_docs_build_clean():
+    """``sphinx-build -W`` when sphinx exists; the strict rst_check
+    substitute otherwise — never skipped."""
+    try:
+        importlib.import_module("sphinx")
+    except ImportError:
+        from rst_check import check_tree
+        problems = check_tree(DOCS)
+        assert not problems, "\n".join(problems)
+        return
     out = subprocess.run(
         [sys.executable, "-m", "sphinx", "-W", "-b", "html", str(DOCS),
          "/tmp/apex_tpu_docs_build"],
         capture_output=True, text=True, timeout=600)
     assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+
+
+def _check_snippet(tmp_path, name, text, extra=None):
+    from rst_check import check_file
+    (tmp_path / "index.rst").write_text("Index\n=====\n")
+    for fname, body in (extra or {}).items():
+        (tmp_path / fname).write_text(body)
+    p = tmp_path / name
+    p.write_text(text)
+    return check_file(p, tmp_path)
+
+
+def test_rst_check_catches_planted_defects(tmp_path):
+    """The substitute checker must actually detect each warning class
+    it claims — otherwise the no-skip build check is a rubber stamp."""
+    cases = {
+        "unknown directive": ".. automodul:: apex_tpu\n",
+        "unknown role": "see :fnc:`apex_tpu.amp.initialize`\n",
+        "short adornment": "A long section title\n===\n",
+        "dead doc target": "see :doc:`no_such_page`\n",
+        "dead include": ".. literalinclude:: ../nope.py\n",
+        "bad code language": ".. code-block:: pythn\n\n   x = 1\n",
+        "unbalanced literal": "an ``unclosed literal here\n\nnext\n",
+        "tab": "a\tb\n",
+    }
+    for label, text in cases.items():
+        problems = _check_snippet(tmp_path, "page.rst", text)
+        assert problems, f"planted defect not caught: {label}"
+
+
+def test_rst_check_accepts_valid_constructs(tmp_path):
+    text = (
+        "A title\n=======\n\n"
+        "Prose with a ``literal that\nwraps lines`` and a "
+        ":func:`~apex_tpu.amp.initialize` role, :doc:`other`.\n\n"
+        ".. code-block:: python\n\n   x = {'not rst': True}\n\n"
+        ".. literalinclude:: snippet.py\n\n"
+        "Literal block follows::\n\n   .. not_a_directive:: ignored\n"
+    )
+    problems = _check_snippet(
+        tmp_path, "page.rst", text,
+        extra={"other.rst": "Other\n=====\n", "snippet.py": "pass\n"})
+    assert not problems, problems
+
+
+def test_rst_check_clean_on_repo_docs():
+    from rst_check import check_tree
+    assert check_tree(DOCS) == []

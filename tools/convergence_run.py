@@ -227,7 +227,7 @@ def run_bert_mlm(steps=600, batch=16, seq=256, hidden=256, layers=4,
 
 def run_dcgan_two_scaler(steps=300, batch=32, image_size=32, zdim=64,
                          lr=2e-4, seed=0, half_dtype="float16",
-                         inject=()):
+                         inject=(), probe_params_every=0):
     """Two-scaler DCGAN: overflows must be observed AND recovered.
 
     Two modes:
@@ -319,7 +319,17 @@ def run_dcgan_two_scaler(steps=300, batch=32, image_size=32, zdim=64,
     t0 = time.perf_counter()
     d_over = g_over = 0
     last_over_step = -1
+    first_bad_param_step = -1
     independence_ok = not inject     # only assessable with injections
+
+    @jax.jit
+    def params_finite(gs, ds):
+        leaves = (jax.tree.leaves(gs.master_params)
+                  + jax.tree.leaves(ds.master_params))
+        return jnp.all(jnp.stack(
+            [jnp.all(jnp.isfinite(leaf.astype(jnp.float32)))
+             for leaf in leaves]))
+
     for i in range(steps):
         kz, kr = jax.random.split(jax.random.PRNGKey(100 + i))
         z = jax.random.normal(kz, (batch, zdim))
@@ -341,6 +351,13 @@ def run_dcgan_two_scaler(steps=300, batch=32, image_size=32, zdim=64,
         if bool(g_o):
             g_over += 1
             last_over_step = i
+        # param-corruption probe (the fp16-on-TPU question): a NaN that
+        # reaches the MASTER params despite every overflowed step being
+        # skipped is compute-dtype corruption, not a scaler failure
+        if (probe_params_every and first_bad_param_step < 0
+                and (i % probe_params_every == 0 or i == steps - 1)):
+            if not bool(params_finite(gs, ds)):
+                first_bad_param_step = i
     finite = bool(np.isfinite(float(dl)) and np.isfinite(float(gl)))
     recovered = finite and last_over_step < steps - 1
     return {"name": "dcgan_two_scaler", "steps": steps, "batch": batch,
@@ -352,14 +369,69 @@ def run_dcgan_two_scaler(steps=300, batch=32, image_size=32, zdim=64,
             "final_g_loss": round(float(gl), 4),
             "final_d_scale": float(d_scale),
             "final_g_scale": float(g_scale),
+            "first_bad_param_step": first_bad_param_step,
             "wall_s": round(time.perf_counter() - t0, 1),
             "ok": bool((d_over + g_over) > 0 and recovered
                        and independence_ok)}
 
 
+def run_dcgan_fp16_natural(steps=300):
+    """fp16-compute DCGAN with NO injections — the natural-overflow
+    exercise, run on whatever backend is live (VERDICT r4 next #7 asks
+    for this ON CHIP).  The record classifies one of three outcomes:
+
+    - ``natural_fp16_proof``: organic overflows occurred, every bad
+      step was skipped, master params stayed finite, training recovered
+      — the airtight on-hardware scaler story.
+    - ``fp16_unviable_on_this_backend``: the scaler did its job (bad
+      steps skipped) yet master params still went non-finite at
+      ``first_bad_param_step`` — measured evidence that the backend's
+      fp16 COMPUTE corrupts the run (r4 carried this only as a
+      docstring claim), so the bf16+injection record remains the chip's
+      scaler exercise.
+    - inconclusive (``ok: false``): fp16 ran clean with zero overflows
+      — neither proof nor finding.
+    """
+    base = run_dcgan_two_scaler(steps=steps, half_dtype="float16",
+                                inject=(), probe_params_every=10)
+    rec = dict(base, name="dcgan_fp16_onchip")
+    over = base["d_overflows"] + base["g_overflows"]
+    finite_end = bool(np.isfinite(base["final_d_loss"])
+                      and np.isfinite(base["final_g_loss"]))
+    # corruption means the MASTER PARAMS went non-finite (the probe
+    # covers the final step too) — a non-finite final-step LOSS alone
+    # is an ordinary organic overflow the scaler just skipped, not
+    # evidence against fp16
+    corrupted = base["first_bad_param_step"] >= 0
+    if corrupted:
+        rec["mode"] = "fp16_unviable_on_this_backend"
+        rec["finding"] = (
+            "master params went non-finite at step "
+            f"{base['first_bad_param_step']} with "
+            f"{over} overflow(s) detected and skipped — fp16 forward/"
+            "backward compute corrupts values before the scaler can "
+            "protect them (non-native dtype on this backend); the "
+            "scaler exercise on chip therefore uses bf16 + targeted "
+            "injection (dcgan_two_scaler)")
+        rec["ok"] = True   # a conclusive, evidenced finding
+    elif over > 0 and finite_end \
+            and base["last_overflow_step"] < steps - 1:
+        rec["mode"] = "natural_fp16_proof"
+        rec["ok"] = True
+    elif over > 0:
+        # overflows happened but the run ended ON one — nothing after
+        # it demonstrates recovery, so neither proof nor finding
+        rec["mode"] = "inconclusive_no_recovery_window"
+        rec["ok"] = False
+    else:
+        rec["mode"] = "inconclusive_no_overflow"
+        rec["ok"] = False
+    return rec
+
+
 def main():
     out_path = Path(sys.argv[1] if len(sys.argv) > 1
-                    else REPO / "CONVERGENCE_r04.json")
+                    else REPO / "CONVERGENCE_r05.json")
     corpus = _corpus()
     records = {}
     # Externally-anchored floors on the same corpus/split (VERDICT r3
@@ -384,7 +456,11 @@ def main():
                # chip record: bf16 dynamics + targeted faults (see the
                # runner's docstring for why fp16 is CPU-only)
                lambda: run_dcgan_two_scaler(half_dtype="bfloat16",
-                                            inject=(60, 150))):
+                                            inject=(60, 150)),
+               # fp16-compute natural-overflow attempt ON THIS BACKEND:
+               # either the organic proof or the measured
+               # fp16-unviability finding (VERDICT r4 next #7)
+               run_dcgan_fp16_natural):
         rec = fn()
         records[rec["name"]] = rec
         print(json.dumps(rec))

@@ -63,24 +63,31 @@ def _hbm_peak() -> float:
     return 819e9
 
 
+def _sync(out) -> float:
+    """Drain the pipeline via a TRUE scalar fetch: slice one element ON
+    DEVICE, transfer 4 bytes.  ``np.asarray(out)`` would ship the whole
+    256 MB result over the ~25-50 MB/s tunnel (~7 s, with enough wire
+    jitter to bury the difference quotient); ``block_until_ready`` does
+    not drain at all on this transport (axon notes)."""
+    leaf = jax.tree.leaves(out)[0]
+    return float(leaf.ravel()[0].astype(jnp.float32))
+
+
 def _time_scan_at(build, k: int, trials: int) -> float:
     """Best-of-``trials`` wall seconds for one compiled scan(k) call,
-    synced by a scalar fetch (not block_until_ready — axon notes)."""
-    import numpy as np
+    synced by a scalar fetch."""
     run, args = build(k)
     compiled = jax.jit(run).lower(*args).compile()
-    out = compiled(*args)
-    np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]  # drain (scalar fetch)
+    _sync(compiled(*args))
     best = float("inf")
     for _ in range(trials):
         t0 = time.perf_counter()
-        out = compiled(*args)
-        np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+        _sync(compiled(*args))
         best = min(best, time.perf_counter() - t0)
     return best
 
 
-def _time_scan(build, iters: int, trials: int = 2) -> float:
+def _time_scan(build, iters: int, trials: int = 3) -> float:
     """Per-step seconds as the difference quotient between scan(iters)
     and scan(6*iters): the constant per-call tunnel overhead (dispatch
     + RTT + fetch) cancels; only the 5*iters extra steps remain."""
@@ -115,22 +122,23 @@ def bench_fused_adam(n: int):
 
 
 def bench_lamb_stage1(n: int):
-    from apex_tpu.ops.pallas.lamb_kernels import (LAMB_CHUNK,
+    from apex_tpu.ops.pallas.lamb_kernels import (grown_chunk,
                                                   packed_lamb_stage1)
 
+    chunk = grown_chunk(n)   # the chunk the production driver packs at n
     def build(k):
         g = jax.random.normal(jax.random.PRNGKey(2), (n,), jnp.float32)
         p = jax.random.normal(jax.random.PRNGKey(3), (n,), jnp.float32)
         m = jnp.zeros((n,), jnp.float32)
         v = jnp.zeros((n,), jnp.float32)
-        decay = jnp.zeros((n // LAMB_CHUNK,), jnp.float32)
+        decay = jnp.zeros((n // chunk,), jnp.float32)
 
         def run(g, p, m, v):
             def body(carry, _):
                 g, m, v = carry
                 u, m, v = packed_lamb_stage1(
                     g, p, m, v, decay, beta1=0.9, beta2=0.999, eps=1e-6,
-                    inv_scale=1.0, bc1=1.0, bc2=1.0)
+                    inv_scale=1.0, bc1=1.0, bc2=1.0, chunk_size=chunk)
                 return (u, m, v), None   # update feeds the next "grad"
             (u, m, v), _ = jax.lax.scan(body, (g, m, v), None, length=k)
             return u
@@ -140,18 +148,20 @@ def bench_lamb_stage1(n: int):
 
 
 def bench_lamb_stage2(n: int):
-    from apex_tpu.ops.pallas.lamb_kernels import (LAMB_CHUNK,
+    from apex_tpu.ops.pallas.lamb_kernels import (grown_chunk,
                                                   packed_lamb_stage2)
 
+    chunk = grown_chunk(n)
     def build(k):
         p = jax.random.normal(jax.random.PRNGKey(4), (n,), jnp.float32)
         u = jax.random.normal(jax.random.PRNGKey(5), (n,), jnp.float32)
-        ratio = jnp.full((n // LAMB_CHUNK,), 1e-3, jnp.float32)
+        ratio = jnp.full((n // chunk,), 1e-3, jnp.float32)
 
         def run(p, u):
             def body(carry, _):
                 p2, _copy = packed_lamb_stage2(
-                    carry, u, ratio, p_copy_dtype=jnp.bfloat16)
+                    carry, u, ratio, chunk_size=chunk,
+                    p_copy_dtype=jnp.bfloat16)
                 return p2, None
             p, _ = jax.lax.scan(body, p, None, length=k)
             return p
@@ -206,10 +216,13 @@ def bench_mt_sumsq(n: int):
         def run(x):
             def body(carry, _):
                 x, s = carry
-                # O(1)-traffic dependence: the accumulated scalar feeds
-                # one element back so the loop body cannot be hoisted
+                # O(1)-traffic dependence: the result feeds one element
+                # back (scaled so the write is non-trivial but the value
+                # drift is ~1e-13) — a literal *0.0 constant-folds away
+                # and lets XLA hoist the whole kernel out of the loop
+                # (measured: "1.3x roofline")
                 r = packed_sumsq(x, CHUNK)
-                x = x.at[0].add(r * 0.0)
+                x = x.at[0].add(r * 1e-20)
                 return (x, s + r), None
             (x, s), _ = jax.lax.scan(body, (x, jnp.float32(0.0)), None,
                                      length=k)
@@ -267,25 +280,30 @@ def bench_layernorm_fwd_bwd(rows: int, hidden: int):
 
 
 def run_suite(tiny: bool = False) -> dict:
-    n = (1 << 16) if tiny else (1 << 24)            # 64 MB fp32 flats
-    rows, hidden = (64, 512) if tiny else (8192, 1024)
-    # difference-quotient span: 5*iters extra steps must dwarf the
-    # per-call RTT jitter (~10 ms) for every kernel, incl. the ~0.1 ms
-    # sumsq pass -> 1500 extra steps at full size
-    iters = 4 if tiny else 300
-    bw = _hbm_peak()
+    # Buffers must EXCEED VMEM (~128 MB) or XLA keeps the scan carry
+    # resident and the measurement reads VMEM bandwidth, not HBM
+    # (observed: a 16 MB layer-norm carry "achieved" 18.7 TB/s).
+    n = (1 << 16) if tiny else (1 << 26)            # 256 MB fp32 flats
+    rows, hidden = (64, 512) if tiny else (1 << 17, 1024)  # 256 MB bf16
+    # difference-quotient span: 5*iters extra device-seconds must dwarf
+    # the per-call RTT jitter (~10 ms); cheap kernels need more steps,
+    # the ~20 ms LAMB stage-1 pass far fewer
+    def it(fast):
+        return 4 if tiny else fast
     suite = {
-        "fused_adam": bench_fused_adam(n),
-        "lamb_stage1": bench_lamb_stage1(n),
-        "lamb_stage2": bench_lamb_stage2(n),
-        "mt_scale": bench_mt_scale(n),
-        "mt_axpby": bench_mt_axpby(n),
-        "mt_sumsq": bench_mt_sumsq(n),
-        "layernorm_fwd": bench_layernorm_fwd(rows, hidden),
-        "layernorm_fwd_bwd": bench_layernorm_fwd_bwd(rows, hidden),
+        "fused_adam": bench_fused_adam(n) + (it(60),),
+        "lamb_stage1": bench_lamb_stage1(n) + (it(30),),
+        "lamb_stage2": bench_lamb_stage2(n) + (it(40),),
+        "mt_scale": bench_mt_scale(n) + (it(150),),
+        "mt_axpby": bench_mt_axpby(n) + (it(150),),
+        "mt_sumsq": bench_mt_sumsq(n) + (it(300),),
+        "layernorm_fwd": bench_layernorm_fwd(rows, hidden) + (it(150),),
+        "layernorm_fwd_bwd": bench_layernorm_fwd_bwd(rows, hidden)
+        + (it(80),),
     }
+    bw = _hbm_peak()
     kernels = {}
-    for name, (build, nbytes) in suite.items():
+    for name, (build, nbytes, iters) in suite.items():
         try:
             sec = _time_scan(build, iters)
             gbps = nbytes / sec / 1e9
@@ -294,19 +312,26 @@ def run_suite(tiny: bool = False) -> dict:
                 "gb_moved": round(nbytes / 1e9, 4),
                 "gbps": round(gbps, 1),
                 "roofline_frac": round(gbps * 1e9 / bw, 4),
+                "iters": iters,
             }
         except Exception as e:  # noqa: BLE001 - per-kernel isolation
             kernels[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
     return {"platform": jax.devices()[0].platform,
             "device_kind": getattr(jax.devices()[0], "device_kind", ""),
-            "n_elements": n, "ln_shape": [rows, hidden], "iters": iters,
+            "n_elements": n, "ln_shape": [rows, hidden],
             "hbm_gbps_peak": bw / 1e9, "kernels": kernels}
 
 
 def compare_kernels(prior_path: str, kernels: dict,
-                    threshold: float = 0.10) -> dict:
+                    threshold: float = 0.10,
+                    geometry: "dict | None" = None) -> dict:
     """Per-kernel step-time gate: worsening >threshold fails; faster is
-    fine; kernels present on only one side are listed, never gated."""
+    fine; kernels present on only one side are listed, never gated.
+
+    ``geometry`` (``{"n_elements": ..., "ln_shape": ...}`` of the
+    CURRENT run) must match the baseline's, or every delta would just
+    measure the size change — mismatched baselines are recorded and
+    never gated."""
     try:
         with open(prior_path) as f:
             doc = json.load(f)
@@ -316,6 +341,12 @@ def compare_kernels(prior_path: str, kernels: dict,
     except (OSError, ValueError, TypeError) as e:
         return {"baseline": prior_path, "ok": True,
                 "error": f"baseline unreadable: {e}"}
+    if geometry is not None:
+        prior_geom = {k: doc.get(k) for k in geometry}
+        if prior_geom != geometry:
+            return {"baseline": Path(prior_path).name, "ok": True,
+                    "error": f"geometry mismatch: baseline {prior_geom}"
+                             f" vs current {geometry} — not comparable"}
     deltas, regressions, uncompared = {}, [], []
     for name, cur in kernels.items():
         old = prior.get(name)
@@ -344,9 +375,10 @@ def main(argv=None):
 
     result = run_suite(tiny=args.tiny)
     if args.compare:
-        result["compare"] = compare_kernels(args.compare,
-                                            result["kernels"],
-                                            args.threshold)
+        result["compare"] = compare_kernels(
+            args.compare, result["kernels"], args.threshold,
+            geometry={"n_elements": result["n_elements"],
+                      "ln_shape": result["ln_shape"]})
     Path(args.out).write_text(json.dumps(result, indent=1))
     print(json.dumps(result))
     if args.compare and not result["compare"]["ok"]:
