@@ -93,6 +93,11 @@ def all_finite_packed(tree) -> jax.Array:
         return jnp.asarray(True)
     by_dtype: dict = {}
     for leaf in leaves:
+        # Mosaic has no f16 vector type ("Unsupported type in mosaic
+        # dialect: 'f16'", found on-chip); the f32 upcast is exact and
+        # preserves inf/nan, so f16 leaves join the f32 group
+        if leaf.dtype == jnp.float16:
+            leaf = leaf.astype(jnp.float32)
         by_dtype.setdefault(leaf.dtype, []).append(leaf.ravel())
     flags = []
     for flats in by_dtype.values():

@@ -890,23 +890,25 @@ def main(argv=None):
         # TPU-native input stem (space-to-depth, +8% over conv7+maxpool)
         record("resnet50_s2d_o2", bench_resnet, optional=True,
                opt_level="O2", s2d=True, **rn_args)
-        # host-streamed input pipeline A/B vs resnet50_o2 (uint8 over
-        # the wire, normalize on device, double-buffered H2D)
-        record("resnet50_o2_hoststream", bench_resnet, optional=True,
-               opt_level="O2", host_stream=True, **rn_args)
-        # pipeline-vs-naive at the compute-visible shape; gated on the
-        # delta sign (ab_ok), not the wire-coupled absolute rate
-        record("resnet50_pipeline_ab_64px", bench_pipeline_ab,
-               optional=True, warmup=3, iters=12)
         # KV-cached decode throughput (bandwidth-bound; see
         # docs/source/models.rst) — serving latency (b1) and a small
-        # serving batch (b8)
+        # serving batch (b8).  Ordered before the wire-coupled and
+        # very-long-context configs: fresh round evidence must not be
+        # the first thing the time budget sheds.
         record("gpt_small_tpu_decode_b1", bench_generate, optional=True,
                batch=1, prefill=2048, new_tokens=256, warmup=1, iters=4,
                tiny=False)
         record("gpt_small_tpu_decode_b8", bench_generate, optional=True,
                batch=8, prefill=2048, new_tokens=256, warmup=1, iters=4,
                tiny=False)
+        # pipeline-vs-naive at the compute-visible shape; gated on the
+        # delta sign (ab_ok), not the wire-coupled absolute rate
+        record("resnet50_pipeline_ab_64px", bench_pipeline_ab,
+               optional=True, warmup=3, iters=12)
+        # host-streamed input pipeline A/B vs resnet50_o2 (uint8 over
+        # the wire, normalize on device, double-buffered H2D)
+        record("resnet50_o2_hoststream", bench_resnet, optional=True,
+               opt_level="O2", host_stream=True, **rn_args)
         # 16K context (fresh: clearing caches avoids the HBM-
         # fragmentation slowdown of back-to-back long-context models in
         # one process); the fused one-pass attention backward still

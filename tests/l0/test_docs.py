@@ -103,6 +103,14 @@ def test_rst_check_catches_planted_defects(tmp_path):
         "bad code language": ".. code-block:: pythn\n\n   x = 1\n",
         "unbalanced literal": "an ``unclosed literal here\n\nnext\n",
         "tab": "a\tb\n",
+        # directive BODIES are real RST — a bare '.. note::' must not
+        # exempt its content from validation (review repro)
+        "bad role inside admonition":
+            ".. note::\n\n   see :fnc:`bad_role`\n",
+        "unknown directive inside admonition":
+            ".. warning::\n\n   .. automodul:: x\n",
+        "rotted toctree entry":
+            ".. toctree::\n   :maxdepth: 2\n\n   no_such_page\n",
     }
     for label, text in cases.items():
         problems = _check_snippet(tmp_path, "page.rst", text)

@@ -322,13 +322,11 @@ def run_dcgan_two_scaler(steps=300, batch=32, image_size=32, zdim=64,
     first_bad_param_step = -1
     independence_ok = not inject     # only assessable with injections
 
+    from apex_tpu.amp.scaler import all_finite
+
     @jax.jit
     def params_finite(gs, ds):
-        leaves = (jax.tree.leaves(gs.master_params)
-                  + jax.tree.leaves(ds.master_params))
-        return jnp.all(jnp.stack(
-            [jnp.all(jnp.isfinite(leaf.astype(jnp.float32)))
-             for leaf in leaves]))
+        return all_finite((gs.master_params, ds.master_params))
 
     for i in range(steps):
         kz, kr = jax.random.split(jax.random.PRNGKey(100 + i))

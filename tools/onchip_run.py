@@ -41,6 +41,8 @@ SELECTION = [
     "tests/l1/test_generate.py::test_single_token_decode",
     "tests/l1/test_generate.py::test_temperature_sampling_deterministic_and_varied",
     "tests/l0/test_conv1x1.py::test_bwd_matches_lax_transpose[2-8-64-256]",
+    # parked flat-packed finite check: one Mosaic numerics pin
+    "tests/l0/test_scaler.py::TestAllFinitePacked::test_mixed_dtype_groups",
     "tests/l0/test_multi_tensor.py",
     "tests/l0/test_fused_adam.py",
     # cross-commit numerical drift gate on the hardware platform

@@ -59,14 +59,21 @@ _ROLE_RE = re.compile(r"(?<!`):([A-Za-z][\w:+-]*):`([^`]+)`")
 _ADORN_RE = re.compile(r"^([=\-`:'\"~^_*+#<>.!$%&(),/;?@\[\]\\{|}])\1*\s*$")
 
 
+#: directives whose body is literal content (skip prose checks inside);
+#: every OTHER directive's body (note, warning, admonition, only, ...)
+#: is real RST that must be validated — treating any line ending in
+#: ``::`` as a literal starter would exempt all directive bodies
+LITERAL_BODY_DIRECTIVES = {
+    "code-block", "code", "math", "parsed-literal", "productionlist",
+    "raw", "highlight",
+}
+
+
 def _strip_literal_blocks(lines):
     """Yield ``(lineno, line, in_literal)`` — checks that parse prose
     must skip literal/code blocks (their content is arbitrary text)."""
     in_block = False
     block_indent = 0
-    block_starter = re.compile(
-        r"(::\s*$)|(^\s*\.\.\s+(code-block|code|math|parsed-literal|"
-        r"productionlist)::)")
     for i, line in enumerate(lines, 1):
         if in_block:
             if line.strip() and (len(line) - len(line.lstrip())
@@ -76,7 +83,12 @@ def _strip_literal_blocks(lines):
                 yield i, line, True
                 continue
         yield i, line, False
-        if block_starter.search(line):
+        dm = _DIRECTIVE_RE.match(line)
+        if dm:
+            starts_literal = dm.group(2).lower() in LITERAL_BODY_DIRECTIVES
+        else:
+            starts_literal = bool(re.search(r"::\s*$", line))
+        if starts_literal:
             in_block = True
             block_indent = len(line) - len(line.lstrip())
 
@@ -111,6 +123,23 @@ def check_file(path: Path, docs_root: Path) -> list[str]:
                 target = (path.parent / m.group(3).strip()).resolve()
                 if not target.exists():
                     err(i, f"{name} target missing: {m.group(3).strip()}")
+            if name == "toctree":
+                # entries are the indented non-option body lines; each
+                # must name an existing page (sphinx -W: "toctree
+                # contains reference to nonexisting document")
+                indent = len(line) - len(line.lstrip())
+                for j in range(i, len(lines)):
+                    body = lines[j]
+                    if not body.strip():
+                        continue
+                    if len(body) - len(body.lstrip()) <= indent:
+                        break
+                    entry = body.strip()
+                    if entry.startswith(":"):   # directive option
+                        continue
+                    if entry not in pages:
+                        err(j + 1, f"toctree entry without a page: "
+                                   f"{entry!r}")
             continue
         for rm in _ROLE_RE.finditer(line):
             role, target = rm.group(1), rm.group(2)
