@@ -100,9 +100,27 @@ def _block(x, p, cfg, kc, vc, layer_i, cos, sin, valid_mask, write_at):
         kc, k.astype(kc.dtype)[None], (layer_i, 0, write_at, 0, 0))
     vc = jax.lax.dynamic_update_slice(
         vc, v.astype(vc.dtype)[None], (layer_i, 0, write_at, 0, 0))
-    kc_l = jax.lax.dynamic_index_in_dim(kc, layer_i, 0, keepdims=False)
-    vc_l = jax.lax.dynamic_index_in_dim(vc, layer_i, 0, keepdims=False)
-    o = _attn_cached(q, kc_l, vc_l, valid_mask, scale)
+    if lq > 1:
+        # prefill: rows 0..lq-1 attending to cache slots <= their own
+        # position IS causal self-attention over the (already-rotated)
+        # prompt q/k/v — run the production flash kernel instead of the
+        # cached einsum, whose (B, H, Lq, M) fp32 score tensor would
+        # materialize ~450 MB at b8/L2048.  Valid ONLY from an empty
+        # cache: a multi-token chunk appended mid-sequence would need
+        # the cached history this branch never reads.
+        if not (isinstance(write_at, int) and write_at == 0):
+            raise NotImplementedError(
+                "multi-token forward with a non-empty cache (chunked "
+                "prefill / speculative verify) is not supported: the "
+                "flash prefill attends only within the chunk")
+        from apex_tpu.attention import attention
+        o = attention(q, k, v, causal=True)
+    else:
+        kc_l = jax.lax.dynamic_index_in_dim(kc, layer_i, 0,
+                                            keepdims=False)
+        vc_l = jax.lax.dynamic_index_in_dim(vc, layer_i, 0,
+                                            keepdims=False)
+        o = _attn_cached(q, kc_l, vc_l, valid_mask, scale)
     o = o.reshape(b, lq, c.hidden_size)
     x = x + (o @ p["attention"]["out"]["kernel"]
              + p["attention"]["out"]["bias"].astype(o.dtype))
