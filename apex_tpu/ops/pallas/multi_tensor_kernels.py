@@ -84,6 +84,12 @@ def packed_scale(flat: jax.Array, scale: jax.Array, chunk_size: int,
             sds((n // _LANES, _LANES), out_dtype, flat),
             sds((1,), jnp.int32, flat),
         ],
+        # same-dtype scaling is in-place (reference semantics: the CUDA
+        # multi-tensor ops write through their tensor lists) — each grid
+        # step touches the same block index, so aliasing is hazard-free
+        # and halves the HBM traffic; XLA copies if the input stays live
+        input_output_aliases=(
+            {1: 0} if jnp.dtype(out_dtype) == flat.dtype else {}),
         interpret=not on_tpu(),
     )(jnp.asarray(scale, jnp.float32).reshape(1), _view2d(flat))
     return out.reshape(-1), flag[0]
@@ -139,6 +145,9 @@ def packed_axpby(x_flat: jax.Array, y_flat: jax.Array, a: jax.Array,
             sds((n // _LANES, _LANES), out_dtype, x_flat),
             sds((1,), jnp.int32, x_flat),
         ],
+        # in-place onto x when dtypes match (see packed_scale)
+        input_output_aliases=(
+            {1: 0} if jnp.dtype(out_dtype) == x_flat.dtype else {}),
         interpret=not on_tpu(),
     )(ab, _view2d(x_flat), _view2d(y_flat))
     return out.reshape(-1), flag[0]

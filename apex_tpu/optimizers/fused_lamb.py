@@ -59,7 +59,7 @@ def _pallas_lamb_update(gs32, ps, ms, vs, *, lr, beta1, beta2, eps,
     from apex_tpu.ops.packing import (
         leaf_sizes, pack_aligned, pack_into, unpack_aligned)
     from apex_tpu.ops.pallas.lamb_kernels import (
-        grown_chunk, packed_lamb_stage1, packed_lamb_stage2)
+        grown_chunk, packed_lamb_stage1)
 
     # Scale the chunk so the SMEM chunk->scalar tables stay bounded (~128 KiB
     # against the ~1 MiB SMEM budget) regardless of model size.  Callers
@@ -90,9 +90,18 @@ def _pallas_lamb_update(gs32, ps, ms, vs, *, lr, beta1, beta2, eps,
                         p_norm / jnp.maximum(u_norm, 1e-38), 1.0)
     chunk_ratio = lr * ratio_t[ids]
 
-    new_p_flat = packed_lamb_stage2(p_flat, u_flat, chunk_ratio,
-                                    chunk_size=chunk)
-    deltas = unpack_aligned(new_p_flat - p_flat, meta)
+    # The optax transform needs the DELTA, and stage 2's
+    # ``p - ratio*u`` minus ``p`` IS ``-ratio*u`` — so the p read/write
+    # (and, with the kernels' in-place aliasing, the full p copy XLA
+    # must insert because p stays live for the subtraction) is dead
+    # weight: compute the delta straight from the update and the
+    # per-chunk trust ratio.  Also avoids the ``(p - r*u) - p``
+    # cancellation rounding.  ``packed_lamb_stage2`` remains the
+    # reference-parity export (multi_tensor_lamb_stage_2) for callers
+    # that materialize new params.
+    delta_flat = (-(u_flat.reshape(-1, chunk)
+                    * chunk_ratio[:, None])).reshape(-1)
+    deltas = unpack_aligned(delta_flat, meta)
     return (deltas,
             unpack_aligned(new_m_flat, meta),
             unpack_aligned(new_v_flat, meta))

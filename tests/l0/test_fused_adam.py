@@ -85,9 +85,12 @@ def test_eps_mode_inside():
     assert float(out_in["w"][0]) < 1.0 and float(out_out["w"][0]) < 1.0
 
 
-def test_adam_step_pallas_matches_jnp(monkeypatch):
+@pytest.mark.parametrize("n_pads", [2, 4])
+def test_adam_step_pallas_matches_jnp(monkeypatch, n_pads):
+    # n_pads=2 -> 16 rows (the 8-row tile-floor blocks); n_pads=4 ->
+    # 32 rows (the larger 32-row blocks) — both grid geometries pinned
     from apex_tpu.ops.pallas.adam_kernel import ADAM_PAD
-    n = ADAM_PAD * 2
+    n = ADAM_PAD * n_pads
     rng = np.random.RandomState(0)
     p = jnp.asarray(rng.randn(n).astype(np.float32))
     m = jnp.asarray(rng.rand(n).astype(np.float32))

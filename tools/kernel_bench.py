@@ -23,6 +23,17 @@ Gate: ``--compare KERNELBENCH_rN.json`` fails (exit 2) when any
 kernel's per-step time worsens by more than ``--threshold`` (default
 10%, calibrated like bench.py's: chip-day variance is ±2-4%).
 
+CAVEAT on reading the optimizer numbers: the chained scans here leave
+every input dead after its call, so ``input_output_aliases`` donation
+would measure ~2x — but the PRODUCTION train step wraps the optimizer
+in the loss-scale skip-``cond``, whose untaken branch returns the old
+state, keeping p/m/v live across the update; XLA then materializes
+full copies and the "win" inverts (measured on chip: BERT-large
+105 -> 54 seq/s with aliased LAMB kernels, and chunk-32768 packing
+OOM'd the b16 step outright).  The multi-tensor scale/axpby kernels DO
+alias in production — their callers run before the skip decision — and
+their numbers here reflect it.
+
 Bytes accounting per kernel (N = elements, fp32 flats unless noted):
 
 - ``fused_adam``    R p+m+v+g (16N)  W p+m+v (12N) + bf16 copy (2N)
