@@ -640,7 +640,12 @@ MFU_FLOORS = {
     "resnet50_o2": 0.30,
     "resnet50_o3": 0.30,
     "resnet50_s2d_o2": 0.32,
-    "gpt_small_o2": 0.42,
+    # r5 same-day spread on this config was 0.4032-0.4211 (-4.3% within
+    # one day): the observed low cleared the former 0.42-floor gate
+    # (0.399) by only 0.8%, thinner than the chip-day variance that
+    # stacks ON TOP of same-day spread — floor widened one point so a
+    # soft day cannot trip it; a real >7% loss still does
+    "gpt_small_o2": 0.41,
     "bert_large_lamb_o2": 0.49,
     "gpt_small_tpu_heads_o2": 0.54,
     "bert_large_tpu_heads_lamb_o2": 0.59,
