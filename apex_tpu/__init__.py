@@ -22,6 +22,10 @@ This package provides the capabilities of NVIDIA Apex (reference:
   utilities, and legacy loss scalers (reference ``apex/fp16_utils``).
 - :mod:`apex_tpu.rnn` — scanned-cell RNN stack: LSTM/GRU/ReLU/Tanh/mLSTM,
   stacked, bidirectional, recurrent projections (reference ``apex/RNN``).
+- :mod:`apex_tpu.analysis` — static graph lint over lowered/compiled
+  programs: donation, sharding, collective-volume, constant-capture, and
+  O1-policy passes (no reference analog — a traced/compiled framework
+  makes the guarantees checkable instead of structural).
 
 Unlike the reference, which monkey-patches eager PyTorch, everything here is
 functional and jit-compiled: loss-scale state is a pytree carried through the
@@ -31,6 +35,7 @@ compute/communication overlap that apex's bucketed NCCL streams did by hand.
 """
 
 from apex_tpu import amp
+from apex_tpu import analysis
 from apex_tpu import checkpoint
 from apex_tpu import data
 from apex_tpu import fp16_utils
@@ -49,6 +54,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "amp",
+    "analysis",
     "checkpoint",
     "data",
     "fp16_utils",

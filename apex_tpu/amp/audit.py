@@ -1,189 +1,30 @@
-"""O1 policy audit — the TPU-native answer to the reference's
-whole-namespace patch guarantee.
+"""Compatibility wrapper: the O1 policy audit now lives behind the
+shared graph-lint pass API as :mod:`apex_tpu.analysis.policy` (the
+``"policy"`` pass of :func:`apex_tpu.analysis.analyze`).
 
-The reference's O1 patches the entire ``torch`` namespace
-(``apex/amp/amp.py:68-177``), so *any* model is policy-covered by
-construction.  apex_tpu's policy layer (:mod:`apex_tpu.amp.ops`) covers
-code that routes through it — a user model calling raw ``jnp``/``lax``
-silently escapes the cast lists.  This module closes that gap the way a
-traced/compiled framework can: walk the LOWERED program and flag
-FP32-list-category work (transcendentals, norm statistics, raw
-accumulation reductions — ``amp/lists.py`` ``FP32_OPS``) executing in a
-16-bit dtype.
+``amp.audit`` / ``amp.audit_text`` / ``amp.format_report`` keep their
+original signatures and report-dict shape (``{ok, violations,
+fp32_matmul_count, custom_call_count}``) — existing callers and
+``tests/l0/test_policy_audit.py`` run unchanged.  New code should
+prefer the structured pass API::
 
-The walk runs on the pre-optimization StableHLO text
-(``jax.jit(fn).lower(*args).as_text()``): that is the program the user
-*asked for*, identical across backends — post-optimization HLO can
-legally rewrite 16-bit math to fp32 internally (the CPU backend does),
-which would hide violations on the platform tests run on.
+    from apex_tpu import analysis
+    report = analysis.analyze(forward, *args, passes=("policy",),
+                              compile=False)
 
-Audit the FORWARD function (the loss/model apply), not the AD-generated
-train step: the policy lists govern ops the user writes, and a backward
-pass legitimately accumulates broadcast/bias gradients in the wire
-dtype — auditing it would drown the report in expected reduce-adds.
-
-Deliberately NOT flagged, mirroring the reference lists:
-
-- ``tanh`` / ``logistic`` / ``erf`` — half-safe activations (gelu,
-  sigmoid); the reference leaves activations in autocast dtype.
-- ``maximum``-reductions (softmax's max pass is exact in any dtype).
-- 16-bit reduces that jnp already upcasts (``jnp.mean``/``sum`` and
-  ``jax.nn.softmax`` accumulate in fp32 and convert back — the audit
-  sees those as fp32 reduces and stays quiet).
-
-Two informational (non-failing) counters round out the picture:
-``fp32_matmul_count`` (dot/conv running in fp32 inside an O1 program =
-missed half-cast opportunities — a perf smell, not a correctness bug)
-and ``custom_call_count`` (Pallas kernels are opaque to the walk; the
-in-tree kernels compute their statistics in fp32 by construction, see
-``ops/pallas/flash_attention.py``).
+See ``apex_tpu/analysis/policy.py`` for the audit's full design notes
+(why the walk runs on pre-optimization StableHLO, why it audits the
+forward rather than the train step, and what is deliberately not
+flagged).
 """
 
 from __future__ import annotations
 
-import re
-from typing import Any, Callable
+from apex_tpu.analysis.policy import (  # noqa: F401
+    BLACKLIST_POINTWISE,
+    audit,
+    audit_text,
+    format_report,
+)
 
-import jax
-
-#: 16-bit element types a violation can execute in.
-_HALF_DTYPES = ("bf16", "f16")
-
-#: StableHLO opcode -> FP32_OPS-category label (amp/lists.py).  These are
-#: the numerically-sensitive pointwise ops the reference keeps in fp32
-#: (``torch_overrides.py:29-56``).
-BLACKLIST_POINTWISE = {
-    "exponential": "exp/softmax",
-    "exponential_minus_one": "expm1",
-    "log": "log/log_softmax",
-    "log_plus_one": "log1p",
-    "power": "pow",
-    "sqrt": "norm-stats",
-    "rsqrt": "norm-stats",
-    "cosine": "trig",
-    "sine": "trig",
-    "tan": "trig",
-    "acos": "trig",
-    "asin": "trig",
-    "atan": "trig",
-    "cosh": "trig",
-    "sinh": "trig",
-}
-
-#: reduce computations whose 16-bit accumulation loses precision
-#: (sum/prod/mean family); max/min/and/or are exact in any dtype.
-_LOSSY_REDUCE_FNS = ("stablehlo.add", "stablehlo.multiply")
-
-_TENSOR_ELEM = re.compile(r"tensor<(?:[0-9?]+x)*([a-z0-9]+)>")
-_OP_LINE = re.compile(r"=\s+(?:stablehlo|chlo)\.([a-z_0-9]+)")
-
-
-def _elem_types(text: str):
-    return _TENSOR_ELEM.findall(text)
-
-
-def _result_elem_type(line: str):
-    """Element type of the op's result: the LAST tensor<> token on the
-    line (StableHLO prints ``: type`` or ``: (operands) -> result``)."""
-    types = _elem_types(line)
-    return types[-1] if types else None
-
-
-def audit_text(stablehlo_text: str) -> dict:
-    """Walk StableHLO text; return the policy-audit report dict."""
-    violations: dict[tuple, dict] = {}
-    fp32_matmuls = 0
-    custom_calls = 0
-
-    def flag_reduce(dtype, lineno, line):
-        key = ("reduce", dtype)
-        rec = violations.setdefault(key, {
-            "op": "reduce", "dtype": dtype,
-            "category": "16-bit accumulation",
-            "count": 0, "first_line": lineno,
-            "example": line.strip()[:200]})
-        rec["count"] += 1
-
-    # a generic-form reduce (multi-result / custom reducer) prints its
-    # header WITHOUT an ``applies`` clause; the adds live in a
-    # ``reducer(...) { ... stablehlo.return }`` region on the following
-    # lines.  Track the open region's header so a lossy op inside it is
-    # attributed to the reduce, not missed.
-    open_reduce = None  # (operand dtype, header lineno, header line)
-
-    for lineno, line in enumerate(stablehlo_text.splitlines(), 1):
-        m = _OP_LINE.search(line)
-        if not m:
-            if open_reduce and "stablehlo.return" in line:
-                open_reduce = None
-            continue
-        op = m.group(1)
-        if open_reduce is not None:
-            if op in ("add", "multiply"):
-                flag_reduce(open_reduce[0], open_reduce[1], open_reduce[2])
-                open_reduce = None
-                continue
-            if op == "return":
-                open_reduce = None
-                continue
-        if op in BLACKLIST_POINTWISE:
-            dtype = _result_elem_type(line)
-            if dtype in _HALF_DTYPES:
-                key = (op, dtype)
-                rec = violations.setdefault(key, {
-                    "op": op, "dtype": dtype,
-                    "category": BLACKLIST_POINTWISE[op],
-                    "count": 0, "first_line": lineno,
-                    "example": line.strip()[:200]})
-                rec["count"] += 1
-        elif op == "reduce":
-            # operand dtype = FIRST tensor token (the reduce input);
-            # jnp's own upcasts make this f32, raw lax.reduce won't
-            types = _elem_types(line)
-            half_in = bool(types) and types[0] in _HALF_DTYPES
-            if any(fn in line for fn in _LOSSY_REDUCE_FNS):
-                if half_in:
-                    flag_reduce(types[0], lineno, line)
-            elif "applies" not in line and half_in:
-                open_reduce = (types[0], lineno, line)
-        elif op in ("dot_general", "dot", "convolution"):
-            if _result_elem_type(line) == "f32":
-                fp32_matmuls += 1
-        elif op == "custom_call":
-            custom_calls += 1
-    out = sorted(violations.values(),
-                 key=lambda r: (-r["count"], r["op"]))
-    return {"ok": not out, "violations": out,
-            "fp32_matmul_count": fp32_matmuls,
-            "custom_call_count": custom_calls}
-
-
-def audit(fn: Callable[..., Any], *args, **kwargs) -> dict:
-    """Lower ``fn`` on ``args``/``kwargs`` and policy-audit the result.
-
-    ``fn`` should be the O1 forward (model apply / loss function) — see
-    the module docstring for why not the full train step.  Accepts an
-    already-jitted function too (``jax.jit`` of a jitted fn is free)."""
-    lowered = jax.jit(fn).lower(*args, **kwargs)
-    return audit_text(lowered.as_text())
-
-
-def format_report(report: dict) -> str:
-    """Human-readable rendering of :func:`audit`'s dict."""
-    lines = []
-    if report["ok"]:
-        lines.append("policy audit: OK — no FP32-list op executes in "
-                     "16-bit")
-    else:
-        lines.append("policy audit: FAIL — FP32-list work executing in "
-                     "16-bit:")
-        for v in report["violations"]:
-            lines.append(
-                f"  {v['op']} [{v['category']}] in {v['dtype']} "
-                f"x{v['count']} (first at line {v['first_line']}): "
-                f"{v['example']}")
-    lines.append(f"  info: {report['fp32_matmul_count']} fp32 "
-                 "matmul/conv ops (missed half casts if this is O1), "
-                 f"{report['custom_call_count']} opaque custom calls "
-                 "(in-tree Pallas kernels keep stats in fp32)")
-    return "\n".join(lines)
+__all__ = ["audit", "audit_text", "format_report", "BLACKLIST_POINTWISE"]

@@ -1,0 +1,60 @@
+"""apex_tpu.analysis — static graph lint over lowered/compiled programs.
+
+The reference apex's core guarantee is structural (O1 patches the whole
+``torch`` namespace, DDP owns the gradient buckets); apex_tpu's
+equivalent guarantees are *checkable*: the program is text, and the
+silent TPU performance/correctness bug classes — dropped buffer
+donation doubling HBM, accidental parameter all-gathers after SPMD
+partitioning, comm-volume regressions, weight-sized constants baked
+into the jaxpr, FP32-list math executing in 16-bit — are all statically
+visible in the lowered StableHLO or compiled HLO.
+
+Usage::
+
+    from apex_tpu import analysis
+
+    step = jax.jit(amp.make_train_step(a, loss_fn), donate_argnums=0)
+    report = analysis.analyze(step, state, x, y)       # graph passes
+    report = report.merged(analysis.analyze(           # + O1 policy
+        forward, params, x, passes=("policy",), compile=False))
+    if not report.ok:
+        raise RuntimeError(report.format())
+
+``tools/graph_lint.py`` runs exactly this over the four in-tree model
+families and is wired into the test suite; per-pass details live in the
+pass modules (:mod:`~apex_tpu.analysis.donation`,
+:mod:`~apex_tpu.analysis.sharding`,
+:mod:`~apex_tpu.analysis.collectives`,
+:mod:`~apex_tpu.analysis.constants`,
+:mod:`~apex_tpu.analysis.policy`).
+"""
+
+from apex_tpu.analysis.core import (
+    DEFAULT_PASSES,
+    PASSES,
+    ArgInfo,
+    PassContext,
+    analyze,
+    analyze_lowered,
+    register_pass,
+    run_passes,
+)
+from apex_tpu.analysis.report import SEVERITIES, Finding, Report
+
+# importing a pass module registers its pass; the import order here is
+# the DEFAULT_PASSES execution order plus the opt-in policy pass
+from apex_tpu.analysis import donation     # noqa: F401  (registers)
+from apex_tpu.analysis import sharding     # noqa: F401  (registers)
+from apex_tpu.analysis import collectives  # noqa: F401  (registers)
+from apex_tpu.analysis import constants    # noqa: F401  (registers)
+from apex_tpu.analysis import policy       # noqa: F401  (registers)
+
+from apex_tpu.analysis.collectives import collective_audit, collective_table
+
+__all__ = [
+    "analyze", "analyze_lowered", "run_passes", "register_pass",
+    "ArgInfo", "PassContext", "Finding", "Report",
+    "PASSES", "DEFAULT_PASSES", "SEVERITIES",
+    "collective_audit", "collective_table",
+    "donation", "sharding", "collectives", "constants", "policy",
+]

@@ -1,0 +1,136 @@
+"""Collective-volume lint over compiled HLO.
+
+This absorbs (and extends) the dryrun's ``_collective_audit`` from
+``__graft_entry__.py``: count collective ops and their output bytes in
+compiled HLO — the per-step communication volume the sharding implies,
+observable from a CPU dryrun alone, no pod needed.  ``__graft_entry__``
+keeps a thin import-alias for compatibility; the parser lives here so
+the same numbers feed the dryrun slice records, the ``collectives``
+lint pass, and its byte-budget gate.
+
+Extensions over the original audit:
+
+- **sync-vs-async spellings** are tallied separately
+  (:func:`collective_table`): an op emitted as ``<kind>-start`` /
+  ``<kind>-done`` is scheduled for overlap by XLA's latency-hiding
+  scheduler, a plain (sync) spelling blocks — a step that was expected
+  to overlap its gradient all-reduce but compiles to the sync spelling
+  is a schedule regression the byte counts alone can't see.
+- **byte budgets** (:func:`collectives_pass`): per-kind and/or total
+  ceilings; exceeding one is an ``error`` finding, so comm-volume
+  regressions fail a lint gate exactly like MFU regressions fail the
+  bench gate.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Mapping, Optional
+
+from apex_tpu.analysis.core import PassContext, register_pass
+from apex_tpu.analysis.report import Finding
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
+                "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+                "f64": 8, "c64": 8, "c128": 16}
+#: Tuple shapes may embed TPU tiled layouts with parentheses
+#: (``{0:T(256)}``), so the tuple alternative tolerates one nesting level.
+_COLLECTIVE_RE = re.compile(
+    r"=\s+(?P<shape>\((?:[^()]|\([^()]*\))*\)|\S+)\s+"
+    r"(?P<kind>all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all|collective-broadcast|ragged-all-to-all)"
+    r"(?P<variant>-start|-done)?\(")
+_SHAPE_RE = re.compile(
+    r"(pred|s8|u8|s16|u16|f16|bf16|s32|u32|f32|s64|u64|f64|c64|c128)"
+    r"\[([0-9,]*)\]")
+
+
+def shape_bytes(dtype: str, dims: str) -> int:
+    """Bytes of one ``dtype[dims]`` HLO shape token (``dims`` as the
+    comma-separated digits inside the brackets)."""
+    n = 1
+    for d in filter(None, dims.split(",")):
+        n *= int(d)
+    return n * _DTYPE_BYTES[dtype]
+
+
+def collective_table(hlo_text: str) -> Dict[str, dict]:
+    """Per-kind ``{count, bytes, sync, async}`` for the collectives in
+    compiled HLO text.
+
+    ``-done`` halves of async pairs are skipped, and a ``-start``'s
+    tuple shape (operand alias + result + context words) counts only the
+    element playing the result role — the largest, except reduce-scatter
+    whose result is the *smallest* element — so the same logical
+    collective audits identical bytes whether XLA emits the sync or
+    async spelling (the spelling itself is recorded in ``sync``/
+    ``async``)."""
+    table: Dict[str, dict] = {}
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        if m.group("variant") == "-done":
+            continue
+        kind = m.group("kind")
+        elems = [shape_bytes(dt, dims)
+                 for dt, dims in _SHAPE_RE.findall(m.group("shape"))]
+        if m.group("variant") == "-start":
+            pick = min if kind == "reduce-scatter" else max
+            nbytes = pick(elems, default=0)
+        else:
+            nbytes = sum(elems)   # sync tuple results are all real buffers
+        slot = table.setdefault(kind, {"count": 0, "bytes": 0,
+                                       "sync": 0, "async": 0})
+        slot["count"] += 1
+        slot["bytes"] += nbytes
+        slot["async" if m.group("variant") == "-start" else "sync"] += 1
+    return table
+
+
+def collective_audit(hlo_text: str) -> Dict[str, dict]:
+    """The original dryrun audit shape: per-kind ``{count, bytes}`` only
+    (``__graft_entry__._collective_audit`` compatibility — slice records
+    and their consumers pin this exact dict)."""
+    return {kind: {"count": rec["count"], "bytes": rec["bytes"]}
+            for kind, rec in collective_table(hlo_text).items()}
+
+
+def collectives_pass(ctx: PassContext,
+                     budget: Optional[Mapping[str, int]] = None,
+                     ) -> List[Finding]:
+    """Collective count/bytes per kind, gated against ``budget``.
+
+    ``budget`` maps a collective kind (``"all-reduce"``, ...) or the
+    key ``"total"`` to a maximum byte count; exceeding one is an
+    ``error``.  ``{"total": 0}`` asserts the program has no collectives
+    at all — the right budget for a single-chip step."""
+    if ctx.hlo_text is None:
+        return [Finding("collectives", "info",
+                        "skipped: program was not compiled "
+                        "(analyze(..., compile=True) to audit "
+                        "collectives)")]
+    table = collective_table(ctx.hlo_text)
+    findings = [
+        Finding("collectives", "info",
+                f"{kind}: {rec['count']} op(s), {rec['bytes']} bytes "
+                f"({rec['async']} async / {rec['sync']} sync)",
+                op=kind, bytes=rec["bytes"], count=rec["count"])
+        for kind, rec in sorted(table.items())]
+    budget = dict(budget or {})
+    total_cap = budget.pop("total", None)
+    for kind, cap in budget.items():
+        got = table.get(kind, {}).get("bytes", 0)
+        if got > cap:
+            findings.append(Finding(
+                "collectives", "error",
+                f"{kind} volume {got} bytes exceeds budget {cap}",
+                op=kind, bytes=got))
+    if total_cap is not None:
+        total = sum(rec["bytes"] for rec in table.values())
+        if total > total_cap:
+            findings.append(Finding(
+                "collectives", "error",
+                f"total collective volume {total} bytes exceeds budget "
+                f"{total_cap}", op="total", bytes=total))
+    return findings
+
+
+register_pass("collectives", collectives_pass)

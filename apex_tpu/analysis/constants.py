@@ -1,0 +1,85 @@
+"""Constant-capture lint: weights baked into the program.
+
+A jitted function that *closes over* an array instead of taking it as
+an argument gets that array burned into the jaxpr as a literal — the
+classic hazard when porting eager training loops: the program re-traces
+(and the executable re-serializes) whenever the "constant" changes, the
+lowered module bloats by the full weight, and donation/sharding can
+never apply to it.  A splat (single repeated value, e.g. an all-zeros
+init cache) is exempt: XLA stores it as scalar + broadcast, so it costs
+nothing and is a normal idiom.
+
+The walk runs on the lowered StableHLO text: captured arrays print as
+``stablehlo.constant dense<...>`` (or ``dense_resource<...>``) with the
+full tensor type, so weight-sized non-splat literals are directly
+visible, with their byte size, before anything compiles.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from apex_tpu.analysis.core import PassContext, register_pass
+from apex_tpu.analysis.report import Finding
+
+#: "weight-sized": 1 MiB of literal data in the program text is a
+#: captured parameter, not a mask or an eps table.
+DEFAULT_MIN_BYTES = 1 << 20
+
+_CONST_LINE = re.compile(
+    r"^\s*%\S+\s*=\s*stablehlo\.constant\s+"
+    r"(?P<form>dense(?:_resource)?)<(?P<value>.*)>\s*:\s*"
+    r"tensor<(?P<type>[0-9x?]*[a-z][a-z0-9]*)>\s*$")
+_ELEM_BYTES = {"i1": 1, "i8": 1, "ui8": 1, "i16": 2, "ui16": 2,
+               "f16": 2, "bf16": 2, "i32": 4, "ui32": 4, "f32": 4,
+               "i64": 8, "ui64": 8, "f64": 8, "complex": 8}
+
+
+def _tensor_bytes(type_str: str) -> "tuple[int, str]":
+    """(nbytes, dtype) of a ``DxDx...xdtype`` tensor-type body."""
+    parts = type_str.split("x")
+    dtype = parts[-1]
+    n = 1
+    for d in parts[:-1]:
+        if not d.isdigit():
+            return 0, dtype   # dynamic dim — not a baked weight
+        n *= int(d)
+    return n * _ELEM_BYTES.get(dtype, 4), dtype
+
+
+def _is_splat(form: str, value: str) -> bool:
+    """``dense<3.0>`` is a splat; ``dense<[...]>``/``dense<"0x...">``/
+    ``dense_resource<...>`` carry per-element data."""
+    return form == "dense" and "[" not in value and '"' not in value
+
+
+def constant_capture_pass(ctx: PassContext,
+                          min_bytes: int = DEFAULT_MIN_BYTES,
+                          ) -> List[Finding]:
+    """Flag non-splat constants of ``min_bytes`` or more in the lowered
+    program — arrays that should almost certainly be arguments."""
+    findings: List[Finding] = []
+    for lineno, line in enumerate(ctx.stablehlo_text.splitlines(), 1):
+        if "stablehlo.constant" not in line:
+            continue
+        m = _CONST_LINE.match(line)
+        if not m:
+            continue
+        if _is_splat(m.group("form"), m.group("value")):
+            continue
+        nbytes, dtype = _tensor_bytes(m.group("type"))
+        if nbytes < min_bytes:
+            continue
+        findings.append(Finding(
+            "constant-capture", "error",
+            f"weight-sized constant tensor<{m.group('type')}> "
+            f"({nbytes} bytes) is baked into the program — a closed-over "
+            f"array that should be passed as an argument (re-traces on "
+            f"every new value; donation/sharding cannot apply)",
+            op="constant", dtype=dtype, bytes=nbytes, lineno=lineno,
+            example=line.strip()[:120]))
+    return findings
+
+
+register_pass("constant-capture", constant_capture_pass)

@@ -1,0 +1,184 @@
+"""Pass harness for the static graph lint.
+
+The reference apex's guarantees are *structural* (patch the whole
+``torch`` namespace, own the gradient buckets); apex_tpu's equivalents
+are *checkable*: the program a user will actually run exists as text —
+pre-optimization StableHLO (what the user asked for) and compiled HLO
+(what the chip will execute) — and the silent TPU performance bugs are
+all statically visible in one of the two:
+
+===================  ====================================================
+pass                 catches
+===================  ====================================================
+``donation``         ``donate_argnums`` that produced no input-output
+                     alias in the compiled executable (double HBM)
+``sharding``         large arrays left fully replicated / parameter-sized
+                     all-gathers after SPMD partitioning
+``collectives``      per-kind collective count/bytes vs a byte budget
+                     (comm-volume regressions fail like MFU regressions)
+``constant-capture`` weight-sized constants baked into the jaxpr instead
+                     of passed as arguments (recompile / bloat hazard)
+``policy``           FP32-list-category work executing in 16-bit
+                     (:mod:`apex_tpu.analysis.policy`, the O1 audit)
+===================  ====================================================
+
+:func:`analyze` lowers (and by default compiles) a jittable function on
+example args, builds a :class:`PassContext`, and runs the named passes;
+each pass is a plain function ``(ctx, **options) -> [Finding]`` looked
+up in :data:`PASSES`.  ``DEFAULT_PASSES`` is the four whole-program
+graph passes; ``policy`` is opt-in because it must run on the FORWARD
+function, not the AD-generated train step (see
+``apex_tpu/analysis/policy.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import warnings
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+
+from apex_tpu.analysis.report import Finding, Report, make_report
+
+
+@dataclasses.dataclass(frozen=True)
+class ArgInfo:
+    """One flattened input of the analyzed program.
+
+    ``index`` is the flat position in the traced signature; ``kept`` is
+    False when jit pruned the argument as unused (``keep_unused=False``,
+    the default) — pruned args do NOT appear in the lowered module's
+    ``main`` signature or the compiled entry parameters, so text-side
+    numbering counts kept args only (see :meth:`kept_position`)."""
+
+    index: int
+    path: str
+    shape: Tuple[int, ...]
+    dtype: str
+    nbytes: int
+    donated: bool
+    kept: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class PassContext:
+    """Everything a lint pass may look at.
+
+    ``hlo_text`` is ``None`` when the program was lowered but not
+    compiled (``analyze(..., compile=False)``); passes that need the
+    compiled program degrade to lowering-time evidence or report an
+    ``info`` finding saying they were skipped.
+    """
+
+    stablehlo_text: str
+    hlo_text: Optional[str] = None
+    args: Tuple[ArgInfo, ...] = ()
+
+    @property
+    def kept_args(self) -> Tuple[ArgInfo, ...]:
+        """Args that survived pruning, in text/parameter order: the
+        k-th entry corresponds to ``%argk`` in the lowered ``main``
+        signature and ``parameter(k)`` in the compiled entry."""
+        return tuple(a for a in self.args if a.kept)
+
+
+#: registry: pass name -> ``fn(ctx, **options) -> [Finding]``.  Pass
+#: modules register themselves on import (see ``analysis/__init__.py``).
+PASSES: Dict[str, Callable[..., List[Finding]]] = {}
+
+#: the whole-program graph passes, safe on any jittable (train steps
+#: included).  ``policy`` is deliberately NOT here — it audits forwards.
+DEFAULT_PASSES = ("donation", "sharding", "collectives",
+                  "constant-capture")
+
+
+def register_pass(name: str, fn: Callable[..., List[Finding]],
+                  replace: bool = False) -> None:
+    if name in PASSES and not replace:
+        raise ValueError(f"pass {name!r} already registered")
+    PASSES[name] = fn
+
+
+def _leaf_nbytes(shape, dtype) -> int:
+    try:
+        itemsize = dtype.itemsize
+    except AttributeError:
+        import numpy as np
+        itemsize = np.dtype(dtype).itemsize
+    return int(math.prod(shape)) * int(itemsize)
+
+
+def _args_info(lowered) -> Tuple[ArgInfo, ...]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(lowered.args_info)
+    try:  # flat indices jit kept (pruned unused args vanish from the text)
+        kept_idx = lowered._lowering.compile_args["kept_var_idx"]
+    except (AttributeError, KeyError, TypeError):
+        kept_idx = None
+    out = []
+    for i, (path, a) in enumerate(flat):
+        out.append(ArgInfo(
+            index=i, path=jax.tree_util.keystr(path),
+            shape=tuple(a.shape), dtype=str(a.dtype),
+            nbytes=_leaf_nbytes(a.shape, a.dtype),
+            donated=bool(getattr(a, "donated", False)),
+            kept=True if kept_idx is None else i in kept_idx))
+    return tuple(out)
+
+
+def run_passes(ctx: PassContext,
+               passes: Optional[Sequence[str]] = None,
+               options: Optional[Mapping[str, Mapping[str, Any]]] = None,
+               ) -> Report:
+    """Run the named passes (default :data:`DEFAULT_PASSES`) over a
+    prepared context.  ``options`` maps pass name -> keyword options for
+    that pass (e.g. ``{"collectives": {"budget": {"total": 0}}}``)."""
+    names = tuple(passes) if passes is not None else DEFAULT_PASSES
+    unknown = [n for n in names if n not in PASSES]
+    if unknown:
+        raise KeyError(f"unknown lint pass(es) {unknown}; registered: "
+                       f"{sorted(PASSES)}")
+    findings: List[Finding] = []
+    for name in names:
+        findings.extend(PASSES[name](ctx, **dict((options or {})
+                                                 .get(name, {}))))
+    return make_report(findings, names)
+
+
+def analyze_lowered(lowered,
+                    passes: Optional[Sequence[str]] = None,
+                    compile: bool = True,
+                    options: Optional[Mapping] = None) -> Report:
+    """Run lint passes over an already-``.lower()``-ed program."""
+    hlo_text = lowered.compile().as_text() if compile else None
+    ctx = PassContext(stablehlo_text=lowered.as_text(),
+                      hlo_text=hlo_text, args=_args_info(lowered))
+    return run_passes(ctx, passes=passes, options=options)
+
+
+def analyze(fn: Callable, *args,
+            passes: Optional[Sequence[str]] = None,
+            compile: bool = True,
+            donate_argnums=(),
+            options: Optional[Mapping] = None,
+            **kwargs) -> Report:
+    """Lower (and compile) ``fn`` on example ``args`` and lint it.
+
+    ``fn`` may already be jitted — its own ``donate_argnums``/sharding
+    configuration is kept (re-jitting would drop donation info, which is
+    exactly what the donation pass exists to check).  Otherwise it is
+    jitted here with ``donate_argnums``.
+
+    JAX's lowering-time "Some donated buffers were not usable" warning
+    is suppressed: turning that warning into a structured, gateable
+    finding is the donation pass's job.
+    """
+    jitted = fn if hasattr(fn, "lower") else \
+        jax.jit(fn, donate_argnums=donate_argnums)
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        lowered = jitted.lower(*args, **kwargs)
+    return analyze_lowered(lowered, passes=passes, compile=compile,
+                           options=options)

@@ -1,0 +1,158 @@
+"""Sharding lint: replicated weights and parameter-sized all-gathers.
+
+After SPMD partitioning, two bug classes are invisible at runtime but
+obvious in the compiled program:
+
+- a large array the user *meant* to shard (FSDP masters, TP weights)
+  arriving fully **replicated** — every device holds the whole buffer,
+  multiplying HBM by the axis size;
+- a **parameter-sized all-gather** inside the train step — the classic
+  signature of a weight that lost its sharding mid-graph and is being
+  re-materialized whole on every device, every step.
+
+Both are read off the compiled HLO: entry parameters carry explicit
+``sharding={...}`` annotations under SPMD, and all-gathers carry their
+output shapes.  Single-program modules (``num_partitions=1``, no device
+assignments) produce no findings — there is nothing to shard.
+
+Intent escalation: pass ``intended={arg-path-substring: PartitionSpec}``
+(see :func:`apex_tpu.parallel.mesh.intended_specs` for building it from
+a sharding/array pytree) and a replicated array whose path matches a
+sharded intent becomes an ``error`` instead of a ``warning`` — the
+program contradicts its declared plan.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Mapping, Optional, Tuple
+
+from apex_tpu.analysis.collectives import (_COLLECTIVE_RE, _SHAPE_RE,
+                                           shape_bytes)
+from apex_tpu.analysis.core import PassContext, register_pass
+from apex_tpu.analysis.report import Finding
+
+#: 1 MiB: smaller fully-replicated arrays (biases, norm scales, scalars)
+#: are replicated by every sane sharding; "large" means weight-sized.
+DEFAULT_MIN_BYTES = 1 << 20
+
+_NUM_PARTITIONS = re.compile(r"num_partitions=(\d+)")
+_DEVICE_COUNT = re.compile(r"<=\[(\d+)\]")
+_PARAM_LINE = re.compile(
+    r"^\s*%\S+\s*=\s*(?P<shape>\w+\[[0-9,]*\])\S*\s+"
+    r"parameter\((?P<num>\d+)\)(?P<rest>.*)$")
+
+
+def num_partitions(hlo_text: str) -> int:
+    """Device count the module is partitioned over (1 = nothing to
+    lint).  The module header's ``num_partitions`` is authoritative;
+    sharding device-assignment spellings are the fallback."""
+    m = _NUM_PARTITIONS.search(hlo_text[:hlo_text.find("\n")])
+    if m:
+        return int(m.group(1))
+    return max((int(d) for d in _DEVICE_COUNT.findall(hlo_text)),
+               default=1)
+
+
+def entry_parameters(hlo_text: str) -> List[Tuple[int, str, str, int, str]]:
+    """(param_number, dtype, dims, nbytes, rest-of-line) for the ENTRY
+    computation's parameters — fusion/reducer computations have their
+    own ``parameter(N)`` lines that must not be confused with program
+    inputs."""
+    start = hlo_text.find("\nENTRY ")
+    if start < 0:
+        return []
+    out = []
+    for line in hlo_text[start + 1:].splitlines()[1:]:
+        if line.startswith("}"):
+            break
+        m = _PARAM_LINE.match(line)
+        if not m:
+            continue
+        sm = _SHAPE_RE.match(m.group("shape"))
+        if not sm:
+            continue
+        dt, dims = sm.group(1), sm.group(2)
+        out.append((int(m.group("num")), dt, dims,
+                    shape_bytes(dt, dims), m.group("rest")))
+    return out
+
+
+def _is_replicated(param_rest: str) -> bool:
+    # under SPMD every entry param is annotated; a missing annotation
+    # means propagation chose for it — treat as replicated (the
+    # conservative reading for a lint that flags replication)
+    return "sharding={devices=" not in param_rest
+
+
+def _spec_is_sharded(spec) -> bool:
+    try:
+        return any(e is not None for e in tuple(spec))
+    except TypeError:
+        return bool(spec)
+
+
+def sharding_pass(ctx: PassContext,
+                  min_bytes: int = DEFAULT_MIN_BYTES,
+                  intended: Optional[Mapping[str, object]] = None,
+                  ) -> List[Finding]:
+    """Flag large replicated entry parameters and parameter-sized
+    all-gathers in a multi-device compiled program.
+
+    ``min_bytes``: replication/gather size that counts as "large".
+    ``intended``: ``{arg-path-substring: PartitionSpec}`` — a matching
+    replicated arg escalates to ``error``."""
+    if ctx.hlo_text is None:
+        return [Finding("sharding", "info",
+                        "skipped: program was not compiled "
+                        "(analyze(..., compile=True) to audit "
+                        "sharding)")]
+    world = num_partitions(ctx.hlo_text)
+    if world <= 1:
+        return []
+    findings: List[Finding] = []
+    intended = dict(intended or {})
+    params = entry_parameters(ctx.hlo_text)
+    # entry params number KEPT args only (pruned unused args vanish)
+    kept = ctx.kept_args
+    index_ok = len(params) == len(kept)
+    for num, dt, dims, nbytes, rest in params:
+        if nbytes < min_bytes or not _is_replicated(rest):
+            continue
+        arg = kept[num] if index_ok and num < len(kept) else None
+        path = arg.path if arg else f"param{num}"
+        spec = next((s for k, s in intended.items() if k in path), None)
+        wants_shard = spec is not None and _spec_is_sharded(spec)
+        sev = "error" if wants_shard else "warning"
+        why = (f" but intent declares PartitionSpec {tuple(spec)}"
+               if wants_shard else "")
+        findings.append(Finding(
+            "sharding", sev,
+            f"large array {path} ({dt}[{dims}], {nbytes} bytes) is "
+            f"fully replicated over {world} devices{why}",
+            op=path, dtype=dt, bytes=nbytes))
+    # the shared collective regex handles BOTH spellings: sync
+    # ``f32[...] all-gather(`` and async tuple-shaped
+    # ``(f32[...], f32[...]) all-gather-start(`` (XLA's latency-hiding
+    # scheduler prefers the async form for exactly the large transfers
+    # this check is about); the result buffer is the largest element.
+    for m in _COLLECTIVE_RE.finditer(ctx.hlo_text):
+        if m.group("kind") != "all-gather" or m.group("variant") == "-done":
+            continue
+        elems = _SHAPE_RE.findall(m.group("shape"))
+        if not elems:
+            continue
+        dt, dims = max(elems, key=lambda e: shape_bytes(*e))
+        nbytes = shape_bytes(dt, dims)
+        if nbytes < min_bytes:
+            continue
+        findings.append(Finding(
+            "sharding", "warning",
+            f"parameter-sized all-gather materializes {dt}[{dims}] "
+            f"({nbytes} bytes) on every device each step — a weight "
+            f"losing its sharding mid-graph looks exactly like this",
+            op="all-gather", dtype=dt, bytes=nbytes))
+    return findings
+
+
+register_pass("sharding", sharding_pass)

@@ -32,6 +32,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.utils.jax_compat import axis_size as _axis_size
+from apex_tpu.utils.jax_compat import pvary as _pvary
+
 NEG_INF = -1e30
 
 
@@ -49,7 +52,7 @@ def _vary_like(reference_array, axis_name):
         vma = tuple(set(jax.typeof(reference_array).vma) | {axis_name})
     except Exception:
         vma = (axis_name,)
-    return lambda t: lax.pvary(t, vma)
+    return lambda t: _pvary(t, vma)
 
 
 def _block_scores(q, k, scale, q_off, k_off, causal, kv_mask):
@@ -76,7 +79,7 @@ def _ring_attention_flash(q, k, v, axis_name, causal, kv_mask, scale):
     from apex_tpu.ops.pallas.flash_attention import NEG_INF as FLASH_NEG
     from apex_tpu.ops.pallas.flash_attention import flash_attention
 
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     b, l_local, h, d = q.shape
     if scale is None:
@@ -169,7 +172,7 @@ def ring_attention(
     if impl == "flash" or (impl is None and _use_pallas_blocks()):
         return _ring_attention_flash(q, k, v, axis_name, causal, kv_mask,
                                      scale)
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     b, l_local, h, d = q.shape
     if scale is None:
@@ -234,7 +237,7 @@ def ulysses_attention(
     preferable to the ring when heads are plentiful and the sequence fits
     once per device.
     """
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     b, l_local, h, d = q.shape
     if h % world != 0:
         raise ValueError(f"heads ({h}) must divide by the axis size "

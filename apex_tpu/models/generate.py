@@ -33,12 +33,28 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from apex_tpu.models.gpt import GPTConfig
 from apex_tpu.normalization.fused_layer_norm import fused_layer_norm_affine
 from apex_tpu.ops.rope import apply_rope, rope_tables
 
 NEG_INF = -1e30
+
+
+def _concrete_zero(v) -> bool:
+    """True iff ``v`` is statically known to be 0: a Python/numpy int,
+    or a CONCRETE 0-d array (``jnp.int32(0)`` from a caller that keeps
+    positions on-device) — a traced value is never statically zero, so
+    the prefill guard still rejects it."""
+    if isinstance(v, jax.core.Tracer):
+        return False
+    if isinstance(v, (int, np.integer)):
+        return int(v) == 0
+    if getattr(v, "ndim", None) == 0 and jnp.issubdtype(
+            getattr(v, "dtype", np.float32), jnp.integer):
+        return int(v) == 0
+    return False
 
 
 def _stack_layer_params(params, num_layers: int):
@@ -108,7 +124,7 @@ def _block(x, p, cfg, kc, vc, layer_i, cos, sin, valid_mask, write_at):
         # materialize ~450 MB at b8/L2048.  Valid ONLY from an empty
         # cache: a multi-token chunk appended mid-sequence would need
         # the cached history this branch never reads.
-        if not (isinstance(write_at, int) and write_at == 0):
+        if not _concrete_zero(write_at):
             raise NotImplementedError(
                 "multi-token forward with a non-empty cache (chunked "
                 "prefill / speculative verify) is not supported: the "

@@ -31,7 +31,9 @@ from apex_tpu.parallel.mesh import (
     DATA_AXIS,
     batch_sharding,
     data_parallel_mesh,
+    intended_specs,
     make_mesh,
+    partition_spec_of,
     replicated_sharding,
     world_size,
 )
@@ -64,4 +66,5 @@ __all__ = [
     "LARC", "larc",
     "mesh", "multiproc", "make_mesh", "data_parallel_mesh", "batch_sharding",
     "replicated_sharding", "world_size", "DATA_AXIS",
+    "intended_specs", "partition_spec_of",
 ]

@@ -13,7 +13,9 @@ note").  What must be ported is the *semantics knob set* (``distributed.py:
 - ``gradient_average`` — divide by world size after the sum;
 - ``gradient_predivide_factor`` — pre-divide by ``f``, post-multiply by
   ``f / world_size`` for dynamic-range management at large world sizes
-  (``distributed.py:379-398``);
+  (``distributed.py:379-398``; the post-scale applies only when
+  ``gradient_average`` is on — with averaging off, grads deliver at
+  ``sum/f``, matching the reference exactly);
 - ``allreduce_always_fp32`` — upcast half grads to fp32 for the wire;
 - ``compression="sign"`` — optional 1-bit sign compression of buckets before
   the collective.  This is the *intent* of the fork's broken
@@ -36,6 +38,9 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from apex_tpu.utils.jax_compat import axis_size as _axis_size
+from apex_tpu.utils.jax_compat import pvary as _pvary
 
 
 class ReduceOp(enum.Enum):
@@ -115,7 +120,7 @@ def pvary_params(params: Any, axis_name: str) -> Any:
     (``allreduce_hook`` inputs) that the caller then reduces explicitly with
     :func:`reduce_gradients`.  No data movement — it only tags the values.
     """
-    return jax.tree.map(lambda p: lax.pvary(p, (axis_name,)), params)
+    return jax.tree.map(lambda p: _pvary(p, (axis_name,)), params)
 
 
 def reduce_gradients(grads: Any, axis_name: str,
@@ -127,7 +132,7 @@ def reduce_gradients(grads: Any, axis_name: str,
     through :func:`pvary_params`; reducing already-summed grads would
     multiply them by the world size.
     """
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
 
     @jax.named_scope("ddp_allreduce")
     def reduce_leaf(g):
@@ -139,13 +144,14 @@ def reduce_gradients(grads: Any, axis_name: str,
         if config.gradient_predivide_factor != 1.0:
             g = g / config.gradient_predivide_factor
         g = lax.psum(g, axis_name)
-        post = 1.0
+        # Reference parity (``distributed.py:387-393``): the post-scale
+        # runs ONLY under gradient_average; with averaging off the grads
+        # stay at sum/f — the predivide is part of the delivered scale,
+        # not cancelled.
         if config.gradient_average:
             post = config.gradient_predivide_factor / world
-        elif config.gradient_predivide_factor != 1.0:
-            post = config.gradient_predivide_factor
-        if post != 1.0:
-            g = g * post
+            if post != 1.0:
+                g = g * post
         return g.astype(orig_dtype)
 
     return jax.tree.map(reduce_leaf, grads)

@@ -56,3 +56,34 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 
 def world_size(mesh: Mesh, axis_name: str = DATA_AXIS) -> int:
     return mesh.shape[axis_name]
+
+
+def partition_spec_of(x) -> Optional[PartitionSpec]:
+    """The :class:`PartitionSpec` carried by ``x`` — a spec itself, a
+    :class:`NamedSharding`, or an array committed to one; ``None`` when
+    ``x`` declares nothing."""
+    if isinstance(x, PartitionSpec):
+        return x
+    if isinstance(x, NamedSharding):
+        return x.spec
+    s = getattr(x, "sharding", None)
+    return s.spec if isinstance(s, NamedSharding) else None
+
+
+def intended_specs(tree) -> dict:
+    """Flatten a pytree of specs / shardings / committed arrays into the
+    ``{arg-path: PartitionSpec}`` intent mapping the graph lint's
+    sharding pass takes (``analysis.analyze(..., options={"sharding":
+    {"intended": ...}})``): entries whose spec actually shards something
+    are kept, replicated/undeclared leaves are dropped.  Declaring the
+    intent from the same tree you ``device_put`` keeps the lint and the
+    placement from drifting apart."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        tree, is_leaf=lambda l: isinstance(l, (PartitionSpec,
+                                               NamedSharding)))
+    out = {}
+    for path, leaf in flat:
+        spec = partition_spec_of(leaf)
+        if spec is not None and any(e is not None for e in tuple(spec)):
+            out[jax.tree_util.keystr(path)] = spec
+    return out

@@ -1,0 +1,41 @@
+"""Version-compat spellings for the small set of SPMD APIs this package
+uses that moved between JAX releases.
+
+The package targets the current VMA-typed SPMD API (``jax.shard_map``,
+``lax.axis_size``, ``lax.pvary``); on older installs (pre-0.5) those
+live elsewhere or don't exist, and every collective component would
+fail on the *spelling* rather than the semantics.  Centralizing the
+fallbacks here keeps each module importing one name instead of
+open-coding try/except at every call site.
+
+- :func:`axis_size` — ``lax.axis_size``, else the classic
+  ``psum(1, axis)`` spelling (folds to a constant under SPMD).
+- :func:`pvary` — ``lax.pvary``, else identity: pre-VMA shard_map
+  gradients already materialize per-rank, which is exactly the state
+  the tag requests, so identity preserves the semantics.
+- :func:`shard_map` — ``jax.shard_map``, else
+  ``jax.experimental.shard_map.shard_map``.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def axis_size(axis_name):
+    """Size of a mapped mesh axis (``lax.axis_size`` compat)."""
+    try:
+        return lax.axis_size(axis_name)
+    except AttributeError:
+        return lax.psum(1, axis_name)
+
+
+pvary = getattr(lax, "pvary", lambda x, axes: x)
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+__all__ = ["axis_size", "pvary", "shard_map"]

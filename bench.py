@@ -693,14 +693,23 @@ def update_ladder_baselines(search_dir: str, configs: dict) -> None:
     future round that lands on a different ladder rung (the tunneled
     chip's usable HBM varies by day) still compares like-for-like
     instead of reporting "uncompared" (VERDICT r4 missing #3/next #4).
-    Best-effort: a read-only checkout must not fail the bench."""
+    Rungs never ratchet DOWNWARD: a slow chip-day may only add missing
+    rungs, not overwrite a faster stored one — otherwise two soft days
+    in a row would quietly lower the bar a real regression is gated
+    against.  Best-effort: a read-only checkout must not fail the
+    bench."""
     path = os.path.join(search_dir, LADDER_BASELINES)
     doc = load_ladder_baselines(search_dir)
     stamp = time.strftime("%Y-%m-%d")
     for name, cur in configs.items():
         if not isinstance(cur, dict) or cur.get("batch") is None:
             continue
-        if not any(k in cur for k in RATE_KEYS):
+        key = next((k for k in RATE_KEYS if cur.get(k)), None)
+        if key is None:
+            continue
+        prev = doc.get(name, {}).get(str(cur["batch"]))
+        if isinstance(prev, dict) and prev.get(key) and \
+                prev[key] > cur[key]:
             continue
         entry = dict(cur)
         entry["recorded"] = stamp
@@ -800,6 +809,24 @@ def compare_configs(prior_path: str, configs: dict,
             "ok": not regressions}
 
 
+def gate_exit_code(regression_check: dict, compare_given: bool) -> int:
+    """2 when the run must fail, else 0.
+
+    The MFU floors and A/B sign checks are ABSOLUTE gates — they need no
+    baseline, so they fail the run with or without ``--compare`` (CI
+    without a BENCH_r*.json must not silently pass an efficiency
+    regression).  The throughput-delta gate stays opt-in via
+    ``--compare``: without a chosen baseline the comparison is recorded
+    in the output but informational."""
+    mfu = regression_check.get("mfu_floors") or {}
+    absolute_failed = bool(regression_check.get("ab_failures")) or \
+        not mfu.get("ok", True)
+    if absolute_failed or (compare_given
+                           and not regression_check.get("ok", True)):
+        return 2
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--compare", metavar="BENCH_rN.json", default=None,
@@ -809,7 +836,10 @@ def main(argv=None):
                          "than --threshold.  Without this flag the "
                          "newest BENCH_r*.json next to the script is "
                          "still compared and the verdict recorded in "
-                         "the output, but never fails the run.")
+                         "the output but the delta gate never fails the "
+                         "run; the ABSOLUTE gates (MFU floors, A/B "
+                         "sign) need no baseline and fail it either "
+                         "way.")
     ap.add_argument("--threshold", type=float, default=0.10,
                     help="fractional per-config drop that counts as a "
                          "regression (default 0.10)")
@@ -975,15 +1005,23 @@ def main(argv=None):
         "configs": configs,
         "regression_check": regression_check,
     }))
-    if opts.compare and regression_check and not regression_check["ok"]:
-        print("bench: gate failed vs "
-              f"{regression_check['baseline']}: throughput regressions "
-              f"{regression_check['regressions']}, MFU-floor violations "
+    rc = gate_exit_code(regression_check, bool(opts.compare))
+    if rc:
+        # an unreadable/missing baseline early-returns a dict WITHOUT
+        # regressions/deltas — the absolute gates must still report
+        # instead of dying on a KeyError after the chip time is spent;
+        # with no baseline at all, name the absolute gates rather than
+        # pointing the triage at a nonexistent comparison
+        base = regression_check.get("baseline")
+        vs = f"vs {base}" if base else "(absolute gates, no baseline)"
+        print(f"bench: gate failed {vs}: throughput "
+              f"regressions {regression_check.get('regressions', [])}, "
+              f"MFU-floor violations "
               f"{(mfu_check or {}).get('violations', [])}, A/B sign "
               f"failures {ab_failures} "
-              f"(deltas {regression_check['deltas']})", file=sys.stderr)
-        return 2
-    return 0
+              f"(deltas {regression_check.get('deltas', {})})",
+              file=sys.stderr)
+    return rc
 
 
 if __name__ == "__main__":

@@ -25,6 +25,7 @@ from apex_tpu.parallel import (
     pvary_params,
     reduce_gradients,
 )
+from apex_tpu.utils.jax_compat import shard_map as _shard_map
 
 WORLD = 8
 
@@ -35,8 +36,8 @@ def mesh():
 
 
 def shmap(mesh, fn, in_specs, out_specs):
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs)
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs)
 
 
 def test_grad_allreduce_closed_form(mesh):
@@ -65,10 +66,34 @@ def test_predivide_postdivide_semantics(mesh, predivide, average):
         return reduce_gradients(g[0], "data", cfg)
 
     out = shmap(mesh, step, (P("data"),), P())(grads)
-    # sum over ranks = 16; average → /8 = 2; no average → predivide cancels
-    # (pre /f then post *f) leaving the plain sum.
-    expected = 2.0 if average else 16.0
+    # sum over ranks = 16; average → post *f/world restores the mean
+    # (/8 = 2); no average → NO post-scale (reference distributed.py:
+    # 387-393 post-scales only when averaging), grads deliver at sum/f.
+    expected = 2.0 if average else 16.0 / predivide
     np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6)
+
+
+def test_no_average_predivide_reference_parity(mesh):
+    """``gradient_average=False`` + predivide ``f``: the reference's
+    ``allreduce_bucket`` divides each grad by ``f`` BEFORE the
+    all-reduce and applies no post-scale unless averaging
+    (``apex/parallel/distributed.py:387-393``) — the delivered grads
+    are ``sum(g_r)/f``, bit-matching a hand-rolled psum(g/f)."""
+    f = 4.0
+    cfg = ReduceConfig(gradient_average=False, gradient_predivide_factor=f)
+    gvals = (jnp.arange(WORLD, dtype=jnp.float32) + 1.0)  # rank r: r+1
+
+    def apex_step(g):
+        return reduce_gradients(g[0], "data", cfg)
+
+    def reference_step(g):
+        return jax.lax.psum(g[0] / f, "data")
+
+    got = shmap(mesh, apex_step, (P("data"),), P())(gvals)
+    want = shmap(mesh, reference_step, (P("data"),), P())(gvals)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+    np.testing.assert_allclose(np.asarray(got),
+                               float(gvals.sum()) / f, rtol=1e-6)
 
 
 def test_fp32_wire_upcast(mesh):

@@ -323,3 +323,53 @@ def test_bench_generate_tiny_cpu():
                              warmup=0, iters=1, peak=None, tiny=True)
     assert r["tok_s"] > 0 and r["batch"] == 2
     assert r["hbm_tok_s_ceiling"] > 0 and r["prefill"] == 16
+
+
+def test_ladder_baselines_never_ratchet_down(tmp_path):
+    """A slow chip-day must not lower a stored rung: only a >= rate
+    overwrites (a lowered bar would mask the next real regression)."""
+    fast = {"gpt_medium_tpu_o2": {"tok_s": 49000.0, "batch": 4}}
+    slow = {"gpt_medium_tpu_o2": {"tok_s": 43000.0, "batch": 4}}
+    faster = {"gpt_medium_tpu_o2": {"tok_s": 50500.0, "batch": 4}}
+    bench.update_ladder_baselines(str(tmp_path), fast)
+    bench.update_ladder_baselines(str(tmp_path), slow)
+    doc = bench.load_ladder_baselines(str(tmp_path))
+    assert doc["gpt_medium_tpu_o2"]["4"]["tok_s"] == 49000.0
+    bench.update_ladder_baselines(str(tmp_path), faster)
+    doc = bench.load_ladder_baselines(str(tmp_path))
+    assert doc["gpt_medium_tpu_o2"]["4"]["tok_s"] == 50500.0
+
+
+def test_gate_exit_code_absolute_gates_fire_without_compare():
+    """MFU-floor and A/B-sign gates are absolute: they fail the run even
+    when no --compare baseline was given (CI without a BENCH_r*.json
+    must not silently pass an efficiency regression)."""
+    bad_mfu = {"ok": True, "mfu_floors": {"ok": False,
+                                          "violations": ["resnet50_o2"]},
+               "ab_failures": []}
+    bad_ab = {"ok": True, "mfu_floors": {"ok": True},
+              "ab_failures": ["resnet50_pipeline_ab_64px"]}
+    clean = {"ok": True, "mfu_floors": {"ok": True}, "ab_failures": []}
+    assert bench.gate_exit_code(bad_mfu, compare_given=False) == 2
+    assert bench.gate_exit_code(bad_ab, compare_given=False) == 2
+    assert bench.gate_exit_code(clean, compare_given=False) == 0
+    # CPU rounds have no MFU record at all — never gated on it
+    assert bench.gate_exit_code({"ok": True, "mfu_floors": None,
+                                 "ab_failures": []},
+                                compare_given=False) == 0
+
+
+def test_gate_exit_code_delta_gate_stays_opt_in():
+    """Throughput deltas fail the run only under --compare; the
+    unreadable-baseline early-return shape (no regressions/deltas keys)
+    must not crash the gate either way."""
+    regressed = {"ok": False, "mfu_floors": {"ok": True},
+                 "ab_failures": [], "regressions": ["gpt_small_o2"],
+                 "deltas": {"gpt_small_o2": -0.2}}
+    assert bench.gate_exit_code(regressed, compare_given=False) == 0
+    assert bench.gate_exit_code(regressed, compare_given=True) == 2
+    unreadable = {"baseline": "BENCH_r99.json", "ok": True,
+                  "error": "baseline unreadable: no configs map",
+                  "mfu_floors": {"ok": False, "violations": ["x"]},
+                  "ab_failures": []}
+    assert bench.gate_exit_code(unreadable, compare_given=True) == 2

@@ -95,3 +95,52 @@ def test_tpu_head_geometry_config():
     want = _naive_generate(model, params, prompt, 5)
     got = generate(params, cfg, prompt, 5)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_prefill_accepts_concrete_zero_start(setup):
+    """The multi-token prefill guard takes a CONCRETE 0-d zero, not just
+    Python ``int`` 0 — a caller that keeps its position counter
+    on-device (``jnp.int32(0)``) must hit the flash-prefill path, not a
+    spurious NotImplementedError.  Logits must match the int-0 call."""
+    from apex_tpu.models.generate import (_forward_cached,
+                                          _stack_layer_params)
+    cfg, _, params, prompt = setup
+    stacked = _stack_layer_params(params, cfg.num_layers)
+    top = {k: v for k, v in params.items() if not k.startswith("block_")}
+    head_dim = cfg.hidden_size // cfg.num_heads
+    m = L_PROMPT + 2
+
+    def caches():
+        kc = jnp.zeros((cfg.num_layers, B, m, cfg.num_heads, head_dim),
+                       jnp.float32)
+        return kc, jnp.zeros_like(kc)
+
+    kc, vc = caches()
+    want, _, _ = _forward_cached(top, stacked, cfg, prompt, kc, vc,
+                                 start=0)
+    kc, vc = caches()
+    got, _, _ = _forward_cached(top, stacked, cfg, prompt, kc, vc,
+                                start=jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5)
+
+
+def test_prefill_still_rejects_nonzero_and_traced_start(setup):
+    """A non-empty cache (start != 0) or a traced start must keep
+    raising: the flash prefill attends only within the chunk."""
+    from apex_tpu.models.generate import _forward_cached
+    from apex_tpu.models.generate import _stack_layer_params
+    cfg, _, params, prompt = setup
+    stacked = _stack_layer_params(params, cfg.num_layers)
+    top = {k: v for k, v in params.items() if not k.startswith("block_")}
+    head_dim = cfg.hidden_size // cfg.num_heads
+    m = L_PROMPT + 4
+    kc = jnp.zeros((cfg.num_layers, B, m, cfg.num_heads, head_dim),
+                   jnp.float32)
+    vc = jnp.zeros_like(kc)
+    with pytest.raises(NotImplementedError, match="non-empty cache"):
+        _forward_cached(top, stacked, cfg, prompt, kc, vc,
+                        start=jnp.int32(2))
+    with pytest.raises(NotImplementedError, match="non-empty cache"):
+        jax.jit(lambda s: _forward_cached(top, stacked, cfg, prompt,
+                                          kc, vc, start=s))(jnp.int32(0))

@@ -1,0 +1,139 @@
+"""Graph lint over the in-tree model families' O1 train steps.
+
+Runs every :mod:`apex_tpu.analysis` pass over the four model families
+(MLP, ResNet, GPT, BERT — tiny configs, CPU-safe, seconds per family):
+
+- the **graph passes** (donation, sharding, collectives,
+  constant-capture) run on the full O1 ``amp.make_train_step`` program
+  with the Amp state donated — the program production actually runs,
+  lowered and compiled on the host backend (no device execution);
+- the **policy pass** runs on the O1 *forward* (the audit's documented
+  scope — the AD-generated backward legitimately accumulates in the
+  wire dtype, see ``apex_tpu/analysis/policy.py``), sharing the model
+  builders with ``tools/policy_audit.py``.
+
+Per-family collective byte budgets are pinned at zero: a single-chip
+train step has no collectives, so ANY appearing is a comm-volume
+regression (multi-chip programs get their budgets where their meshes
+are built — the dryrun slices in ``__graft_entry__.py``).
+
+One JSON line per family plus a human summary; exit 1 on any finding of
+``error`` severity — wired as ``tests/l0/test_graph_lint.py`` so the
+clean-program guarantee is continuously enforced.
+
+Usage:
+    python tools/graph_lint.py [--families mlp,gpt] [--passes donation,...]
+                               [--no-compile] [-v]
+"""
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+# CPU-safe by default: lint lowers/compiles for the host platform unless
+# the caller pins a real chip (same env knob as the test suite).  Must
+# happen before any jax backend initialization; the env-level
+# JAX_PLATFORMS pin (sitecustomize) is overridden at the config level.
+os.environ.setdefault("APEX_TPU_KERNELS", "jnp")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms",
+                  os.environ.get("APEX_TPU_TEST_PLATFORM", "cpu"))
+
+from apex_tpu import amp, analysis  # noqa: E402
+from apex_tpu.optimizers import FusedAdam  # noqa: E402
+
+import policy_audit  # noqa: E402  (sibling tool: shared model builders)
+
+GRAPH_PASSES = ("donation", "sharding", "collectives", "constant-capture")
+ALL_PASSES = GRAPH_PASSES + ("policy",)
+
+#: single-chip train steps imply ZERO collective bytes; any regression
+#: that introduces one (an accidental psum, a sharding annotation leak)
+#: fails the gate like an MFU-floor violation fails the bench.
+COLLECTIVE_BUDGETS = {"mlp": {"total": 0}, "resnet": {"total": 0},
+                      "gpt": {"total": 0}, "bert": {"total": 0}}
+
+FAMILIES = tuple(policy_audit.RAW_CASES)
+
+
+def build_train_step(family: str, raw=None):
+    """(jitted_step, example_args): the full O1 train step — FusedAdam,
+    dynamic loss scaling, Amp state donated — for one model family.
+    ``raw`` reuses an already-built ``(loss_fn, params, batch)``."""
+    loss_fn, params, batch = raw or policy_audit.RAW_CASES[family]()
+    a = amp.initialize(optimizer=FusedAdam(lr=1e-3), opt_level="O1",
+                       verbosity=0)
+    state = a.init(params)
+    step = jax.jit(amp.make_train_step(a, loss_fn), donate_argnums=0)
+    return step, (state, *batch)
+
+
+def lint_family(family: str, passes=ALL_PASSES, compile: bool = True):
+    """Run the requested passes over one family; returns the merged
+    :class:`~apex_tpu.analysis.Report` (train-step graph passes +
+    forward policy pass).  The model is built once and shared between
+    the two analyzed programs."""
+    graph = tuple(p for p in passes if p != "policy")
+    raw = loss_fn, params, batch = policy_audit.RAW_CASES[family]()
+    report = analysis.Report()
+    if graph:
+        step, args = build_train_step(family, raw=raw)
+        report = analysis.analyze(
+            step, *args, passes=graph, compile=compile,
+            options={"collectives":
+                     {"budget": COLLECTIVE_BUDGETS.get(family, {})}})
+    if "policy" in passes:
+        a = amp.initialize(opt_level="O1", verbosity=0)
+        fwd = lambda p, *b: a.run(loss_fn, p, *b)  # noqa: E731
+        report = report.merged(analysis.analyze(
+            fwd, params, *batch, passes=("policy",), compile=False))
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--families", default=",".join(FAMILIES),
+                    help=f"comma list from {FAMILIES}")
+    ap.add_argument("--passes", default=",".join(ALL_PASSES),
+                    help=f"comma list from {ALL_PASSES}")
+    ap.add_argument("--no-compile", action="store_true",
+                    help="lower only (donation falls back to lowering-"
+                         "time aliasing; sharding/collectives passes "
+                         "report themselves skipped)")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="print every finding, not just errors")
+    opts = ap.parse_args(argv)
+
+    families = [f.strip() for f in opts.families.split(",") if f.strip()]
+    passes = tuple(p.strip() for p in opts.passes.split(",") if p.strip())
+    unknown = [f for f in families if f not in FAMILIES]
+    if unknown:
+        ap.error(f"unknown families {unknown}; have {FAMILIES}")
+
+    failed = []
+    for family in families:
+        report = lint_family(family, passes=passes,
+                             compile=not opts.no_compile)
+        print(json.dumps({"family": family, **report.to_dict()}))
+        if not report.ok:
+            failed.append(family)
+            print(f"--- {family} ---\n{report.format()}", file=sys.stderr)
+        elif opts.verbose:
+            print(f"--- {family} ---\n{report.format()}", file=sys.stderr)
+    if failed:
+        print(f"graph lint FAILED for: {failed}", file=sys.stderr)
+        return 1
+    print(f"graph lint: all families OK "
+          f"({', '.join(families)}; passes: {', '.join(passes)})",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -4,7 +4,7 @@ The reference guarantees O1 coverage by patching the whole ``torch``
 namespace (``apex/amp/amp.py:68-177``); apex_tpu's equivalent guarantee
 is checkable instead of structural: :func:`apex_tpu.amp.audit` walks the
 lowered StableHLO of an O1 forward and flags FP32-list-category work
-executing in 16-bit (see ``apex_tpu/amp/audit.py``).
+executing in 16-bit (the ``policy`` pass of :mod:`apex_tpu.analysis`).
 
 This tool runs that audit over the four in-tree model families' O1
 forwards (MLP, ResNet, GPT, BERT — tiny configs; lowering only, nothing
@@ -17,6 +17,11 @@ enforced, and runnable standalone on user models:
     a = amp.initialize(opt_level="O1", verbosity=0)
     report = amp.audit(lambda p, x: a.run(model.apply, p, x), params, x)
     print(amp.format_report(report))
+
+``RAW_CASES`` exposes the un-wrapped ``(loss_fn, params, batch)`` per
+family so ``tools/graph_lint.py`` can build full O1 *train steps* from
+the same models for the whole-program graph passes; ``CASES`` keeps the
+original ``() -> (audited_fn, args)`` shape the tests pin.
 """
 
 import json
@@ -37,50 +42,47 @@ def _wrap(a, loss_fn):
     return lambda params, *batch: a.run(loss_fn, params, *batch)
 
 
-def mlp_case():
+def mlp_raw():
     from apex_tpu.models.mlp import MLP, cross_entropy_loss
     model = MLP(features=(32,))
     x = jnp.ones((4, 28, 28, 1), jnp.float32)
     y = jnp.zeros((4,), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), x)["params"]
-    a = amp.initialize(opt_level="O1", verbosity=0)
 
     def loss_fn(p, xb, yb):
         return cross_entropy_loss(model.apply({"params": p}, xb), yb)
-    return _wrap(a, loss_fn), (params, x, y)
+    return loss_fn, params, (x, y)
 
 
-def resnet_case():
+def resnet_raw():
     from apex_tpu.models.resnet import ResNet
     model = ResNet(stage_sizes=(1, 1), num_classes=10, width=8)
     x = jnp.ones((2, 32, 32, 3), jnp.float32)
     y = jnp.zeros((2,), jnp.int32)
     variables = model.init(jax.random.PRNGKey(0), x, train=True)
     params, batch_stats = variables["params"], variables["batch_stats"]
-    a = amp.initialize(opt_level="O1", verbosity=0)
 
     def loss_fn(p, xb, yb):
         logits, _ = model.apply({"params": p, "batch_stats": batch_stats},
                                 xb, train=True, mutable=["batch_stats"])
         logp = jax.nn.log_softmax(logits.astype(jnp.float32))
         return -jnp.mean(jnp.take_along_axis(logp, yb[:, None], 1))
-    return _wrap(a, loss_fn), (params, x, y)
+    return loss_fn, params, (x, y)
 
 
-def gpt_case():
+def gpt_raw():
     from apex_tpu.models.gpt import GPTModel, gpt_tiny, lm_loss
     model = GPTModel(gpt_tiny())
     ids = jnp.zeros((2, 32), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), ids)["params"]
-    a = amp.initialize(opt_level="O1", verbosity=0)
 
     def loss_fn(p, xb):
         logits = model.apply({"params": p}, xb)
         return lm_loss(logits[:, :-1], xb[:, 1:])
-    return _wrap(a, loss_fn), (params, ids)
+    return loss_fn, params, (ids,)
 
 
-def bert_case():
+def bert_raw():
     from apex_tpu.models.bert import (BertForPreTraining, bert_tiny,
                                       pretraining_loss)
     model = BertForPreTraining(bert_tiny())
@@ -89,22 +91,35 @@ def bert_case():
     mlm_mask = jnp.ones((2, 32), jnp.float32)
     nsp_labels = jnp.zeros((2,), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), ids[:, :8])["params"]
-    a = amp.initialize(opt_level="O1", verbosity=0)
 
     def loss_fn(p, ids, mlm_labels, nsp_labels, mlm_mask):
         mlm_logits, nsp_logits = model.apply({"params": p}, ids)
         return pretraining_loss(mlm_logits, nsp_logits, mlm_labels,
                                 nsp_labels, mlm_mask)
-    return _wrap(a, loss_fn), (params, ids, mlm_labels, nsp_labels,
-                               mlm_mask)
+    return loss_fn, params, (ids, mlm_labels, nsp_labels, mlm_mask)
 
 
-CASES = {
-    "mlp": mlp_case,
-    "resnet": resnet_case,
-    "gpt": gpt_case,
-    "bert": bert_case,
+#: family -> () -> (loss_fn, params, batch) — the un-wrapped pieces,
+#: shared with tools/graph_lint.py's train-step builders.
+RAW_CASES = {
+    "mlp": mlp_raw,
+    "resnet": resnet_raw,
+    "gpt": gpt_raw,
+    "bert": bert_raw,
 }
+
+
+def _make_case(raw):
+    def case():
+        loss_fn, params, batch = raw()
+        a = amp.initialize(opt_level="O1", verbosity=0)
+        return _wrap(a, loss_fn), (params, *batch)
+    return case
+
+
+#: family -> () -> (audited_fn, args): the O1 forward under the cast
+#: context (the original shape tests/l0/test_policy_audit.py pins).
+CASES = {name: _make_case(raw) for name, raw in RAW_CASES.items()}
 
 
 def run_all() -> dict:
