@@ -25,6 +25,32 @@ import jax.numpy as jnp
 import numpy as np
 
 
+#: Lane width / sublane tile floor of the flat-view streaming kernels
+#: (packed Adam and the persistent-flat FP16Optimizer layout derive
+#: their alignment from THESE constants — ops/pallas/adam_kernel.py
+#: imports them — so the padder and the kernel's assert can never
+#: desync).
+STREAM_LANES = 1024
+STREAM_TILE_ROWS = 8
+
+
+def round_up(x: int, multiple: int) -> int:
+    """Smallest multiple of ``multiple`` ≥ ``x``."""
+    return int(-(-x // multiple) * multiple)
+
+
+def streaming_pad(total: int, *, lanes: int = STREAM_LANES,
+                  tile_rows: int = STREAM_TILE_ROWS) -> int:
+    """Padded length for a flat buffer feeding the streaming Pallas
+    kernels: a whole number of ``(tile_rows, lanes)`` tiles — the ONLY
+    alignment the retuned kernels still require.  Block geometry itself
+    needs no padding: the selector's bigger row blocks ride Mosaic's
+    masked last grid block over ragged row counts
+    (:mod:`apex_tpu.ops.pallas.geometry`), so callers no longer pad to a
+    block multiple, just to the dtype tile."""
+    return round_up(max(total, 1), lanes * tile_rows)
+
+
 class PackMeta(NamedTuple):
     """Static metadata describing a packed tensor list."""
 

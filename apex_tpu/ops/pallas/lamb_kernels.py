@@ -4,17 +4,32 @@ TPU-native equivalents of ``csrc/multi_tensor_lamb_stage_1.cu:17-121`` and
 ``csrc/multi_tensor_lamb_stage_2.cu:18-92``.  The CUDA kernels resolve
 per-tensor arguments (weight decay, trust ratio) through the block→tensor
 table packed into kernel argument space; here the tensor list is packed
-chunk-*aligned* (:func:`apex_tpu.ops.packing.pack_aligned`) so each grid step
-covers exactly one tensor's chunk, and the per-chunk scalar table sits whole
-in SMEM, indexed by ``program_id`` — the direct analog of
-``TensorListMetadata``'s block→tensor map living in kernel argument space.
+chunk-*aligned* (:func:`apex_tpu.ops.packing.pack_aligned`) so chunks never
+straddle tensors, and the per-chunk scalar table sits whole in SMEM —
+the direct analog of ``TensorListMetadata``'s block→tensor map living in
+kernel argument space.
 
 Stage boundaries mirror the CUDA split: stage 1 is the gradient
 descale/clip → Adam moment update → ``update = m̂/(√v̂+ε) + decay·p`` pass;
-per-tensor ‖p‖/‖update‖ norms are reduced *between* the stages (the role of
-``multi_tensor_l2norm``'s per-tensor output feeding stage 2); stage 2 applies
+per-tensor ‖p‖/‖update‖ norms feed stage 2 (the role of
+``multi_tensor_l2norm``'s per-tensor output); stage 2 applies
 ``p ← p − ratio·update`` with the per-tensor trust ratio (lr folded in, with
 the plain-lr fallback when either norm is zero).  All arithmetic is fp32.
+
+Memory movement (round 6 retune): one grid step streams
+``chunks_per_block`` chunks (shared selector,
+:mod:`apex_tpu.ops.pallas.geometry`) instead of a single (8, 128) tile —
+the geometry that left the stages at 0.13–0.17 of HBM peak while
+mt_axpby's big blocks hit 0.81 on the same chip (KERNELBENCH_r05).  The
+chunk sub-blocks are statically unrolled so each keeps its own SMEM
+table scalars, and ragged chunk counts ride Mosaic's masked last block
+(the scalar tables are padded to the grid so the dead tail indexes real
+slots).  Stage 1 optionally FUSES the per-tensor norm reductions into
+the streaming pass (``with_norms=True``): per-chunk ‖p‖²/‖update‖²
+partials land in SMEM accumulator tables keyed by the existing
+chunk→tensor map, saving the two extra full passes
+(``per_tensor_sumsq_from_packed`` re-reading p and u, 8N bytes) the
+driver paid between the stages.
 """
 
 from __future__ import annotations
@@ -27,9 +42,10 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops import on_tpu, sds
-from apex_tpu.ops.pallas.multi_tensor_kernels import _LANES, _block, _view2d
+from apex_tpu.ops.pallas import geometry
+from apex_tpu.ops.pallas.multi_tensor_kernels import _LANES, _view2d
 
-#: Base chunk size for aligned packing: one (8, 128) fp32 tile per grid step.
+#: Base chunk size for aligned packing: one (8, 128) fp32 tile per chunk.
 LAMB_CHUNK = 8 * 128
 
 #: Upper bound on chunks per call — keeps the SMEM scalar tables (fp32 per
@@ -68,63 +84,124 @@ def tree_within_packed_capacity(ps) -> bool:
     return aligned_chunk_count(sizes, grown_chunk(total)) <= MAX_CHUNKS
 
 
+def stage1_geometry(n: int, chunk_size: int,
+                    chunks_per_block: "int | None" = None
+                    ) -> geometry.StreamGeometry:
+    """Stage-1 streaming geometry (7 fp32 streams: g+p+m+v in,
+    u+m+v out) — shared by the kernel, its tests, and
+    ``tools/kernel_bench.py``."""
+    return geometry.chunked_geometry(n, chunk_size,
+                                     row_bytes=_LANES * 4 * 7,
+                                     lanes=_LANES,
+                                     chunks_per_block=chunks_per_block)
+
+
+def stage2_geometry(n: int, chunk_size: int, *, with_copy: bool,
+                    chunks_per_block: "int | None" = None
+                    ) -> geometry.StreamGeometry:
+    """Stage-2 geometry (p+u in, p out, optional half writeback)."""
+    return geometry.chunked_geometry(
+        n, chunk_size,
+        row_bytes=_LANES * (3 * 4 + (2 if with_copy else 0)),
+        lanes=_LANES, chunks_per_block=chunks_per_block)
+
+
 def _stage1_kernel(scalars_ref, decay_ref, bc1_ref, bc2_ref, g_ref, p_ref,
-                   m_ref, v_ref, u_ref, out_m_ref, out_v_ref):
+                   m_ref, v_ref, u_ref, out_m_ref, out_v_ref, *rest,
+                   chunk_rows, chunks_per_block):
     beta1 = scalars_ref[0]
     beta2 = scalars_ref[1]
     eps = scalars_ref[2]
     inv_scale = scalars_ref[3]   # 1 / clip_factor (grads arrive descaled)
-    # Per-tensor weight decay AND bias correction (1 - beta^step, or 1.0)
-    # resolved through the chunk->tensor tables in SMEM, indexed by grid
-    # position — the role of TensorListMetadata's block_to_tensor map
-    # (multi_tensor_apply.cuh:17-24).  Bias correction is per tensor, not
-    # a launch-wide scalar, because each param leaf carries its own step
-    # count (reference fused_adam.py:119-125 state per param).
-    decay = decay_ref[pl.program_id(0)]
-    bc1 = bc1_ref[pl.program_id(0)]
-    bc2 = bc2_ref[pl.program_id(0)]
+    i = pl.program_id(0)
 
-    g = g_ref[...].astype(jnp.float32) * inv_scale
-    p = p_ref[...].astype(jnp.float32)
-    m = beta1 * m_ref[...].astype(jnp.float32) + (1.0 - beta1) * g
-    v = beta2 * v_ref[...].astype(jnp.float32) + (1.0 - beta2) * g * g
-    update = (m / bc1) / (jnp.sqrt(v / bc2) + eps) + decay * p
-    u_ref[...] = update
-    out_m_ref[...] = m
-    out_v_ref[...] = v
+    for j in range(chunks_per_block):
+        # Per-tensor weight decay AND bias correction (1 - beta^step, or
+        # 1.0) resolved through the chunk->tensor tables in SMEM — the
+        # role of TensorListMetadata's block_to_tensor map
+        # (multi_tensor_apply.cuh:17-24).  Bias correction is per tensor,
+        # not a launch-wide scalar, because each param leaf carries its
+        # own step count (reference fused_adam.py:119-125 state per
+        # param).
+        c = i * chunks_per_block + j
+        decay = decay_ref[c]
+        bc1 = bc1_ref[c]
+        bc2 = bc2_ref[c]
+        rows = slice(j * chunk_rows, (j + 1) * chunk_rows)
+
+        g = g_ref[rows, :].astype(jnp.float32) * inv_scale
+        p = p_ref[rows, :].astype(jnp.float32)
+        m = beta1 * m_ref[rows, :].astype(jnp.float32) + (1.0 - beta1) * g
+        v = beta2 * v_ref[rows, :].astype(jnp.float32) + (1.0 - beta2) * g * g
+        update = (m / bc1) / (jnp.sqrt(v / bc2) + eps) + decay * p
+        u_ref[rows, :] = update
+        out_m_ref[rows, :] = m
+        out_v_ref[rows, :] = v
+        if rest:  # fused ‖p‖²/‖update‖² per-chunk partials (with_norms)
+            rest[0][c] = (p * p).sum()
+            rest[1][c] = (update * update).sum()
 
 
-@functools.partial(jax.jit, static_argnames=("chunk_size",))
+@functools.partial(jax.jit, static_argnames=("chunk_size", "chunks_per_block",
+                                             "with_norms"))
 def packed_lamb_stage1(g: jax.Array, p: jax.Array, m: jax.Array,
                        v: jax.Array, per_chunk_decay: jax.Array, *,
                        beta1, beta2, eps, inv_scale, bc1, bc2,
-                       chunk_size: int = LAMB_CHUNK):
+                       chunk_size: int = LAMB_CHUNK,
+                       chunks_per_block: "int | None" = None,
+                       with_norms: bool = False):
     """Stage 1 over chunk-aligned flat fp32 buffers.
 
     ``per_chunk_decay``: fp32 ``(n_chunks,)`` — weight decay per chunk (i.e.
     per tensor, via ``AlignedMeta.chunk_ids``).  ``bc1``/``bc2`` may be
     scalars (all tensors at the same step) or ``(n_chunks,)`` arrays
     (per-tensor step counts).  Returns ``(update, new_m, new_v)`` flat
-    fp32 buffers.
+    fp32 buffers — plus ``(p_sumsq, u_sumsq)`` per-chunk ``(n_chunks,)``
+    tables when ``with_norms`` (the fused inter-stage norm partials; a
+    segment add over ``AlignedMeta.chunk_ids`` turns them into the
+    per-tensor norms, identical partials to
+    ``multi_tensor.per_tensor_sumsq_from_packed`` without re-reading the
+    flat buffers).
     """
     n = g.shape[0]
     n_chunks = n // chunk_size
-    br = _block(chunk_size)
+    chunk_rows = chunk_size // _LANES
+    geom = stage1_geometry(n, chunk_size, chunks_per_block)
+    slots = geom.grid * geom.chunks_per_block
     scalars = jnp.stack([
         jnp.asarray(beta1, jnp.float32),
         jnp.asarray(beta2, jnp.float32),
         jnp.asarray(eps, jnp.float32),
         jnp.asarray(inv_scale, jnp.float32),
     ])
-    bc1 = jnp.broadcast_to(jnp.asarray(bc1, jnp.float32), (n_chunks,))
-    bc2 = jnp.broadcast_to(jnp.asarray(bc2, jnp.float32), (n_chunks,))
+    decay = geometry.pad_table(per_chunk_decay.astype(jnp.float32), slots)
+    bc1 = geometry.pad_table(
+        jnp.broadcast_to(jnp.asarray(bc1, jnp.float32), (n_chunks,)), slots)
+    bc2 = geometry.pad_table(
+        jnp.broadcast_to(jnp.asarray(bc2, jnp.float32), (n_chunks,)), slots)
 
     def spec():
-        return pl.BlockSpec(br, lambda i: (i, 0))
+        return pl.BlockSpec((geom.block_rows, _LANES), lambda i: (i, 0))
 
-    u, new_m, new_v = pl.pallas_call(
-        _stage1_kernel,
-        grid=(n_chunks,),
+    out_specs = [spec(), spec(), spec()]
+    out_shape = [sds((n // _LANES, _LANES), jnp.float32, g, p, m, v)
+                 for _ in range(3)]
+    if with_norms:
+        # SMEM partial tables are revisited whole each grid step — the
+        # grid must stay sequential ("arbitrary"); without them every
+        # step touches disjoint blocks and the grid pipelines as
+        # "parallel".
+        out_specs += [pl.BlockSpec(memory_space=pltpu.SMEM)] * 2
+        out_shape += [sds((slots,), jnp.float32, g, p, m, v)
+                      for _ in range(2)]
+        semantics = ("arbitrary",)
+    else:
+        semantics = ("parallel",)
+
+    outs = pl.pallas_call(
+        functools.partial(_stage1_kernel, chunk_rows=chunk_rows,
+                          chunks_per_block=geom.chunks_per_block),
+        grid=(geom.grid,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -132,35 +209,49 @@ def packed_lamb_stage1(g: jax.Array, p: jax.Array, m: jax.Array,
             pl.BlockSpec(memory_space=pltpu.SMEM),
             spec(), spec(), spec(), spec(),
         ],
-        out_specs=[spec(), spec(), spec()],
-        out_shape=[sds((n // _LANES, _LANES), jnp.float32, g, p, m, v)
-                   for _ in range(3)],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=semantics),
         interpret=not on_tpu(),
-    )(scalars, per_chunk_decay.astype(jnp.float32), bc1, bc2, _view2d(g),
-      _view2d(p), _view2d(m), _view2d(v))
-    return u.reshape(-1), new_m.reshape(-1), new_v.reshape(-1)
+    )(scalars, decay, bc1, bc2, _view2d(g), _view2d(p), _view2d(m),
+      _view2d(v))
+    u, new_m, new_v = (o.reshape(-1) for o in outs[:3])
+    if with_norms:
+        return u, new_m, new_v, outs[3][:n_chunks], outs[4][:n_chunks]
+    return u, new_m, new_v
 
 
-def _stage2_kernel(ratio_ref, p_ref, u_ref, out_p_ref, *rest):
-    ratio = ratio_ref[pl.program_id(0)]  # lr·trust ratio for this tensor
-    p = p_ref[...].astype(jnp.float32) - ratio * u_ref[...]
-    out_p_ref[...] = p.astype(out_p_ref.dtype)
-    if rest:  # optional half-precision param writeback
-        rest[0][...] = p.astype(rest[0].dtype)
+def _stage2_kernel(ratio_ref, p_ref, u_ref, out_p_ref, *rest, chunk_rows,
+                   chunks_per_block):
+    i = pl.program_id(0)
+    for j in range(chunks_per_block):
+        # lr·trust ratio for this chunk's tensor
+        ratio = ratio_ref[i * chunks_per_block + j]
+        rows = slice(j * chunk_rows, (j + 1) * chunk_rows)
+        p = p_ref[rows, :].astype(jnp.float32) - ratio * u_ref[rows, :]
+        out_p_ref[rows, :] = p.astype(out_p_ref.dtype)
+        if rest:  # optional half-precision param writeback
+            rest[0][rows, :] = p.astype(rest[0].dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("chunk_size", "p_copy_dtype"))
+@functools.partial(jax.jit, static_argnames=("chunk_size", "p_copy_dtype",
+                                             "chunks_per_block"))
 def packed_lamb_stage2(p: jax.Array, u: jax.Array,
                        per_chunk_ratio: jax.Array, *,
-                       chunk_size: int = LAMB_CHUNK, p_copy_dtype=None):
+                       chunk_size: int = LAMB_CHUNK, p_copy_dtype=None,
+                       chunks_per_block: "int | None" = None):
     """Stage 2: ``p ← p − ratio·update`` with the per-chunk (= per-tensor)
     trust ratio in SMEM.  Returns ``new_p`` (or ``(new_p, p_copy)``)."""
     n = p.shape[0]
-    n_chunks = n // chunk_size
-    br = _block(chunk_size)
+    chunk_rows = chunk_size // _LANES
+    geom = stage2_geometry(n, chunk_size, with_copy=p_copy_dtype is not None,
+                           chunks_per_block=chunks_per_block)
+    ratio = geometry.pad_table(per_chunk_ratio.astype(jnp.float32),
+                       geom.grid * geom.chunks_per_block)
 
     def spec():
-        return pl.BlockSpec(br, lambda i: (i, 0))
+        return pl.BlockSpec((geom.block_rows, _LANES), lambda i: (i, 0))
 
     out_shape = [sds((n // _LANES, _LANES), p.dtype, p, u)]
     out_specs = [spec()]
@@ -169,16 +260,19 @@ def packed_lamb_stage2(p: jax.Array, u: jax.Array,
         out_specs.append(spec())
 
     outs = pl.pallas_call(
-        _stage2_kernel,
-        grid=(n_chunks,),
+        functools.partial(_stage2_kernel, chunk_rows=chunk_rows,
+                          chunks_per_block=geom.chunks_per_block),
+        grid=(geom.grid,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             spec(), spec(),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel",)),
         interpret=not on_tpu(),
-    )(per_chunk_ratio.astype(jnp.float32), _view2d(p), _view2d(u))
+    )(ratio, _view2d(p), _view2d(u))
     if p_copy_dtype is None:
         return outs[0].reshape(-1)
     return outs[0].reshape(-1), outs[1].reshape(-1)

@@ -13,10 +13,18 @@ TPU-native equivalent of ``csrc/layer_norm_cuda_kernel.cu``:
   sequential TPU grid into a single output tile — the grid itself is the
   second reduction stage.
 
-Rows are padded to a block multiple in the wrapper (padded rows produce
-garbage stats that are sliced away; they cannot NaN because the input pad is
-zeros and eps > 0).  Feature dims not divisible by 128 fall back to the jnp
-path at the call site (`supported`).
+Forward geometry (round 6 retune) comes from the shared selector
+(:mod:`apex_tpu.ops.pallas.geometry`): per-row statistics make the block
+size numerics-free, so the forward streams the largest row block whose
+double-buffered working set fits the VMEM budget, with ragged row counts
+riding Mosaic's masked last block (no padding pass at all) and the grid
+declared ``parallel`` so the pipeliner overlaps DMA with the row
+reductions.  The BACKWARD keeps the fixed 128-row blocks: its dγ/dβ
+partials accumulate across the sequential grid, so the block size sets
+the summation ORDER — part of the bit-exact digest contract the L1
+conformance tier pins — and its rows stay padded to the block multiple.
+Feature dims not divisible by 128 fall back to the jnp path at the call
+site (`supported`).
 """
 
 from __future__ import annotations
@@ -30,8 +38,20 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops import on_tpu, sds
+from apex_tpu.ops.pallas import geometry
 
 _BLOCK_ROWS = 128
+
+
+def fwd_block_rows(n1: int, n2: int, x_dtype,
+                   block_rows: "int | None" = None) -> int:
+    """Forward row block from the shared selector: x in + y out + the
+    8 B/row fp32 stats, 16-row multiples (the bf16 sublane floor)."""
+    if block_rows:
+        return block_rows
+    xb = jnp.dtype(x_dtype).itemsize
+    return geometry.select_block_rows(
+        max(n1, 1), row_bytes=n2 * 2 * xb + 8, multiple_of=16)
 
 
 def supported(n2: int) -> bool:
@@ -89,35 +109,38 @@ def _pad_rows(x: jax.Array, rows: int) -> jax.Array:
     return x
 
 
-@functools.partial(jax.jit, static_argnames=("eps", "affine"))
-def _forward(x2d, w, b, eps: float, affine: bool):
+@functools.partial(jax.jit,
+                   static_argnames=("eps", "affine", "block_rows"))
+def _forward(x2d, w, b, eps: float, affine: bool,
+             block_rows: "int | None" = None):
     n1, n2 = x2d.shape
-    xp = _pad_rows(x2d, n1)
-    rows = xp.shape[0]
-    grid = rows // _BLOCK_ROWS
+    br = fwd_block_rows(n1, n2, x2d.dtype, block_rows)
+    grid = -(-n1 // br)   # ragged tail rides the masked last block
     w2 = (w if w is not None else jnp.ones((n2,), jnp.float32)).reshape(1, n2)
     b2 = (b if b is not None else jnp.zeros((n2,), jnp.float32)).reshape(1, n2)
     y, mean, inv = pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps, affine=affine),
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((_BLOCK_ROWS, n2), lambda i: (i, 0)),
+            pl.BlockSpec((br, n2), lambda i: (i, 0)),
             pl.BlockSpec((1, n2), lambda i: (0, 0)),
             pl.BlockSpec((1, n2), lambda i: (0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((_BLOCK_ROWS, n2), lambda i: (i, 0)),
-            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
-            pl.BlockSpec((_BLOCK_ROWS, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, n2), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            sds((rows, n2), x2d.dtype, x2d),
-            sds((rows, 1), jnp.float32, x2d),
-            sds((rows, 1), jnp.float32, x2d),
+            sds((n1, n2), x2d.dtype, x2d),
+            sds((n1, 1), jnp.float32, x2d),
+            sds((n1, 1), jnp.float32, x2d),
         ],
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel",)),
         interpret=not on_tpu(),
-    )(xp, w2, b2)
-    return y[:n1], mean[:n1], inv[:n1]
+    )(x2d, w2, b2)
+    return y, mean, inv
 
 
 @functools.partial(jax.jit, static_argnames=("affine",))

@@ -56,7 +56,7 @@ class FP16Optimizer:
                  dynamic_loss_scale: bool = False,
                  max_grad_norm: float = 0.0,
                  model_dtype=jnp.bfloat16,
-                 pad_to: int = 8 * 1024):
+                 pad_to: "int | None" = None):
         self.lr = lr
         self.beta1, self.beta2 = betas
         self.eps = eps
@@ -75,8 +75,13 @@ class FP16Optimizer:
         self._sizes = [int(np.prod(s)) if s else 1 for s in self._shapes]
         total = sum(self._sizes)
         # Pad so the Pallas fused-Adam path tiles cleanly (reference pads via
-        # chunked multi_tensor launches instead).
-        self._padded = int(-(-max(total, 1) // pad_to) * pad_to)
+        # chunked multi_tensor launches instead).  The default is the
+        # (8, 1024) fp32 tile (``packing.streaming_pad``) — the retuned
+        # kernel's only remaining alignment; its block geometry handles
+        # ragged row counts itself, so no block-multiple padding here.
+        from apex_tpu.ops import packing
+        self._padded = (packing.round_up(max(total, 1), pad_to) if pad_to
+                        else packing.streaming_pad(total))
         self._total = total
         self._init_flat = self._flatten(leaves, jnp.float32)
 

@@ -57,12 +57,19 @@ def _adam_math(p32, m32, v32, g32, *, beta1, beta2, eps, step_size, scale,
 def adam_step(p: jax.Array, m: jax.Array, v: jax.Array, g: jax.Array,
               *, lr, beta1: float, beta2: float, eps: float, step: jax.Array,
               scale=1.0, weight_decay: float = 0.0, eps_mode: int = EPS_MODE_OUTSIDE,
-              bias_correction: bool = True, p_copy_dtype=None):
+              bias_correction: bool = True, p_copy_dtype=None,
+              donate: bool = False):
     """One fused Adam update. All math in fp32 regardless of storage dtype.
 
     Returns ``(new_p, new_m, new_v[, p_copy])``.  ``step`` is the 1-based step
     count *after* this update (the reference increments state['step'] before
     calling the kernel, ``fused_adam.py:119-133``).
+
+    ``donate=True`` aliases the (p, m, v) buffers in-place on the Pallas
+    path (``input_output_aliases``) — ONLY for callers whose inputs are
+    dead after the call: under the loss-scale skip-``cond`` the old state
+    stays live and XLA's inserted copies invert the win (see the
+    adam_kernel module docstring for the on-chip measurement).
     """
     if bias_correction:
         bc1 = 1.0 - jnp.power(beta1, step.astype(jnp.float32))
@@ -76,7 +83,7 @@ def adam_step(p: jax.Array, m: jax.Array, v: jax.Array, g: jax.Array,
         return packed_adam(p, m, v, g, step_size=step_size, beta1=beta1,
                            beta2=beta2, eps=eps, scale=scale,
                            weight_decay=weight_decay, eps_mode=eps_mode,
-                           p_copy_dtype=p_copy_dtype)
+                           p_copy_dtype=p_copy_dtype, donate=donate)
 
     p32, m32, v32, g32 = (x.astype(jnp.float32) for x in (p, m, v, g))
     p32, m32, v32 = _adam_math(

@@ -75,17 +75,24 @@ def _pallas_lamb_update(gs32, ps, ms, vs, *, lr, beta1, beta2, eps,
     ids = jnp.asarray(np.array(meta.chunk_ids), jnp.int32)
 
     decay = jnp.full((n_chunks,), weight_decay, jnp.float32)
-    u_flat, new_m_flat, new_v_flat = packed_lamb_stage1(
+    # Stage 1 with the norm reductions FUSED into the streaming pass
+    # (with_norms): the per-chunk ‖p‖²/‖update‖² partials ride SMEM
+    # accumulator tables keyed by the chunk→tensor map, so the flat p/u
+    # buffers are not re-read between the stages (8N bytes saved vs the
+    # round-5 per_tensor_sumsq_from_packed passes — same partials, same
+    # segment add, one read earlier).
+    u_flat, new_m_flat, new_v_flat, p_sq, u_sq = packed_lamb_stage1(
         g_flat, p_flat, m_flat, v_flat, decay,
         beta1=beta1, beta2=beta2, eps=eps, inv_scale=1.0 / clip,
-        bc1=bc1[ids], bc2=bc2[ids], chunk_size=chunk)
+        bc1=bc1[ids], bc2=bc2[ids], chunk_size=chunk, with_norms=True)
 
-    # Per-tensor ‖p‖ / ‖update‖ between the stages: the fused per-chunk
-    # sumsq kernel segment-reduced by tensor id (the per-tensor output of
-    # multi_tensor_l2norm feeding lamb stage 2 in the reference).
-    from apex_tpu.ops.multi_tensor import per_tensor_sumsq_from_packed
-    p_norm = jnp.sqrt(per_tensor_sumsq_from_packed(p_flat, meta))
-    u_norm = jnp.sqrt(per_tensor_sumsq_from_packed(u_flat, meta))
+    # Per-tensor ‖p‖ / ‖update‖ between the stages (the per-tensor output
+    # of multi_tensor_l2norm feeding lamb stage 2 in the reference).
+    n_tensors = len(meta.shapes)
+    p_norm = jnp.sqrt(
+        jnp.zeros((n_tensors,), jnp.float32).at[ids].add(p_sq))
+    u_norm = jnp.sqrt(
+        jnp.zeros((n_tensors,), jnp.float32).at[ids].add(u_sq))
     ratio_t = jnp.where((p_norm > 0) & (u_norm > 0),
                         p_norm / jnp.maximum(u_norm, 1e-38), 1.0)
     chunk_ratio = lr * ratio_t[ids]

@@ -1,0 +1,88 @@
+"""tools/gate_hygiene.py — the gate's memory must be committed.
+
+The repo-level test IS the tier-1 wiring (VERDICT r5 weak #7): a round
+whose gate-baseline artifacts are modified-but-uncommitted fails the
+suite, so the ladder/kernel-gate memory can never drift silently past a
+green tier-1.  The unit tests pin the verdict classes on throwaway git
+repos.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO / "tools"))
+
+import gate_hygiene  # noqa: E402
+
+
+def test_repo_gate_artifacts_committed():
+    """Tier-1 wiring: THIS checkout's gate baselines are tracked and
+    clean (skip-records pass — e.g. a tarball export without git)."""
+    verdict = gate_hygiene.check(str(REPO))
+    assert verdict["ok"], verdict
+
+
+def _git(repo, *args):
+    subprocess.run(["git", "-C", str(repo), "-c", "user.email=t@t",
+                    "-c", "user.name=t", *args], check=True,
+                   capture_output=True)
+
+
+@pytest.fixture
+def tmp_repo(tmp_path):
+    try:
+        _git(tmp_path, "init", "-q")
+    except (OSError, subprocess.CalledProcessError):
+        pytest.skip("git unavailable")
+    for name in gate_hygiene.REQUIRED:
+        (tmp_path / name).write_text("{}")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    return tmp_path
+
+
+def test_clean_repo_passes(tmp_repo):
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert verdict["ok"], verdict
+
+
+def test_modified_baseline_fails(tmp_repo):
+    (tmp_repo / "BENCH_LADDER_BASELINES.json").write_text('{"drift": 1}')
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert verdict["dirty"] == ["BENCH_LADDER_BASELINES.json"]
+    assert gate_hygiene.main(["--repo", str(tmp_repo)]) == 1
+
+
+def test_untracked_round_artifact_fails(tmp_repo):
+    (tmp_repo / "KERNELBENCH_r06.json").write_text("{}")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert verdict["untracked"] == ["KERNELBENCH_r06.json"]
+    # ...and committing it restores green
+    _git(tmp_repo, "add", "KERNELBENCH_r06.json")
+    _git(tmp_repo, "commit", "-q", "-m", "r06 artifact")
+    assert gate_hygiene.check(str(tmp_repo))["ok"]
+
+
+def test_missing_required_fails(tmp_repo):
+    _git(tmp_repo, "rm", "-q", "SCALING_SWEEP.json")
+    _git(tmp_repo, "commit", "-q", "-m", "drop")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert verdict["missing"] == ["SCALING_SWEEP.json"]
+
+
+def test_non_repo_records_skip(tmp_path):
+    verdict = gate_hygiene.check(str(tmp_path))
+    assert verdict["ok"] and "skipped" in verdict
+
+
+def test_non_gate_files_ignored(tmp_repo):
+    (tmp_repo / "scratch.json").write_text("{}")
+    (tmp_repo / "KERNELBENCH.json").write_text("{}")  # un-numbered out
+    assert gate_hygiene.check(str(tmp_repo))["ok"]

@@ -373,3 +373,123 @@ def test_gate_exit_code_delta_gate_stays_opt_in():
                   "mfu_floors": {"ok": False, "violations": ["x"]},
                   "ab_failures": []}
     assert bench.gate_exit_code(unreadable, compare_given=True) == 2
+
+
+# ---------------------------------------------------------------------------
+# Round-6 floor hygiene: kernel floors surfaced in the gate, and the
+# no-ratchet-down rule over every published floor table.
+
+#: Frozen snapshots of the floor tables as committed in round 6.  The
+#: erosion guard below compares the LIVE tables against these: raising a
+#: floor updates the snapshot in the same commit (fine — gains ratchet
+#: the bar up); LOWERING one without a BENCH_VARIANCE.json entry whose
+#: recorded spread covers the drop fails this suite.  Deleting a floor
+#: is erosion too.
+MFU_FLOOR_SNAPSHOT_R06 = {
+    "resnet50_o2": 0.30,
+    "resnet50_o3": 0.30,
+    "resnet50_s2d_o2": 0.32,
+    "gpt_small_o2": 0.41,
+    "bert_large_lamb_o2": 0.49,
+    "gpt_small_tpu_heads_o2": 0.54,
+    "bert_large_tpu_heads_lamb_o2": 0.59,
+    "gpt_small_tpu_heads_L8192_o2": 0.55,
+    "gpt_small_tpu_heads_L16384_o2": 0.51,
+    "gpt_medium_tpu_o2": 0.58,
+}
+KERNEL_FLOOR_SNAPSHOT_R06 = {
+    "fused_adam": 0.30,
+    "lamb_stage1": 0.17,
+    "lamb_stage2": 0.12,
+    "mt_scale": 0.75,
+    "mt_axpby": 0.80,
+    "mt_sumsq": 0.63,
+    "layernorm_fwd": 0.34,
+    "layernorm_fwd_bwd": 0.51,
+}
+
+
+def _kernel_floors():
+    sys.path.insert(0, str(REPO / "tools"))
+    import kernel_bench
+    return kernel_bench.KERNEL_FLOORS
+
+
+def test_floors_never_erode_without_variance_evidence():
+    """Every floor change must be accompanied by recorded variance
+    (VERDICT r5 weak #1: floors lowered on soft days absorb real
+    regressions; the band then does the load-bearing work the floor was
+    supposed to do)."""
+    variance = bench.load_variance(str(REPO))
+    for name, old in MFU_FLOOR_SNAPSHOT_R06.items():
+        new = bench.MFU_FLOORS.get(name)
+        assert new is not None, f"floor for {name} deleted (erosion)"
+        assert bench.floor_change_allowed(name, old, new, variance), (
+            f"{name}: floor lowered {old} -> {new} without a "
+            "BENCH_VARIANCE.json entry covering the drop — run "
+            "tools/bench_variance.py on chip and commit the artifact")
+    kfloors = _kernel_floors()
+    for name, old in KERNEL_FLOOR_SNAPSHOT_R06.items():
+        new = kfloors.get(name)
+        assert new is not None, f"kernel floor for {name} deleted"
+        assert bench.floor_change_allowed(name, old, new, variance,
+                                          kind="kernel"), (
+            f"{name}: kernel floor lowered {old} -> {new} without "
+            "variance evidence")
+
+
+def test_floor_change_allowed_rule():
+    """The rule itself: raise always; lower only with a non-tiny
+    variance entry whose rel_spread covers the drop."""
+    assert bench.floor_change_allowed("x", 0.30, 0.31, None)
+    assert not bench.floor_change_allowed("x", 0.30, 0.29, None)
+    doc = {"entries": {"config:x": {"rel_spread": 0.05},
+                       "kernel:k": {"rel_spread": 0.10}}}
+    # -3.3% drop inside the recorded 5% spread: allowed
+    assert bench.floor_change_allowed("x", 0.30, 0.29, doc)
+    # -17% drop far beyond it: refused
+    assert not bench.floor_change_allowed("x", 0.30, 0.25, doc)
+    # kernel floors key the kernel: namespace
+    assert bench.floor_change_allowed("k", 0.17, 0.16, doc, kind="kernel")
+    assert not bench.floor_change_allowed("x", 0.30, 0.29, doc,
+                                          kind="kernel")
+    # the MFU sub-statistic wins for configs when recorded
+    mfu_doc = {"entries": {"config:x": {"rel_spread": 0.20,
+                                        "mfu": {"rel_spread": 0.01}}}}
+    assert not bench.floor_change_allowed("x", 0.30, 0.29, mfu_doc)
+    # tiny-smoke artifacts are not evidence
+    assert not bench.floor_change_allowed(
+        "x", 0.30, 0.29, {"tiny": True,
+                          "entries": {"config:x": {"rel_spread": 0.9}}})
+
+
+def test_gate_exit_code_kernel_floors_absolute():
+    """A kernel-floor violation from the committed KERNELBENCH artifact
+    fails the model bench too — the 2%-of-step kernel regression cannot
+    hide behind a green model round."""
+    bad = {"ok": True, "mfu_floors": {"ok": True},
+           "kernel_floors": {"ok": False, "violations": ["fused_adam"]},
+           "ab_failures": []}
+    assert bench.gate_exit_code(bad, compare_given=False) == 2
+    # no kernel artifact at all (fresh checkout): never gated on it
+    assert bench.gate_exit_code({"ok": True, "mfu_floors": {"ok": True},
+                                 "kernel_floors": None,
+                                 "ab_failures": []},
+                                compare_given=False) == 0
+
+
+def test_check_kernel_floor_artifact_reads_committed_round():
+    """The repo's newest committed KERNELBENCH_r*.json passes the
+    published floors (floors sit at-or-below the measured values, the
+    MFU_FLOORS convention) and unreadable artifacts never fail."""
+    out = bench.check_kernel_floor_artifact(str(REPO))
+    assert out is not None and out["ok"], out
+    assert out["artifact"].startswith("KERNELBENCH_r")
+    # unreadable artifact: recorded, never failing
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        (Path(d) / "KERNELBENCH_r07.json").write_text("{not json")
+        broken = bench.check_kernel_floor_artifact(d)
+        assert broken["ok"] and "error" in broken
+        assert bench.check_kernel_floor_artifact(
+            tempfile.gettempdir() + "/definitely_empty_dir_xyz") is None

@@ -1,0 +1,60 @@
+"""Units for tools/bench_variance.py (the real N≥5 runs happen on the
+driver's chip): the stats shapes, the artifact contract the floor
+no-ratchet-down rule consumes, and a CPU-safe tiny smoke.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO / "tools"))
+sys.path.insert(0, str(REPO))
+
+import bench_variance as bv  # noqa: E402
+
+
+def test_stats_shape():
+    s = bv._stats([1.0, 1.1, 0.9])
+    assert s["n"] == 3 and s["mean"] == 1.0
+    assert s["min"] == 0.9 and s["max"] == 1.1
+    assert abs(s["rel_spread"] - 0.2) < 1e-9
+
+
+def test_tiny_smoke_writes_consumable_artifact(tmp_path):
+    """End-to-end at tiny N on CPU: the artifact parses, carries the
+    tiny marker (so it can never justify a floor drop), and its entry
+    keys match what bench.floor_change_allowed looks up."""
+    out = tmp_path / "BENCH_VARIANCE.json"
+    rc = bv.main(["--out", str(out), "--n", "2", "--tiny",
+                  "--kernels", "mt_scale,fused_adam"])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["tiny"] is True
+    for key in ("kernel:mt_scale", "kernel:fused_adam"):
+        entry = doc["entries"][key]
+        assert "error" not in entry, entry
+        assert entry["metric"] == "ms_per_step" and entry["n"] == 2
+        assert entry["rel_spread"] is not None
+        assert "geometry" in entry
+
+    import bench
+    # a tiny artifact is NOT evidence for lowering a floor...
+    assert not bench.floor_change_allowed("mt_scale", 0.75, 0.70, doc,
+                                          kind="kernel")
+    # ...but the same shape without the tiny marker and a covering
+    # spread is — the exact consumption path of the erosion guard
+    real = dict(doc, tiny=False)
+    real["entries"]["kernel:mt_scale"]["rel_spread"] = 0.10
+    assert bench.floor_change_allowed("mt_scale", 0.75, 0.70, real,
+                                      kind="kernel")
+
+
+def test_unknown_names_recorded_not_fatal(tmp_path):
+    out = tmp_path / "V.json"
+    rc = bv.main(["--out", str(out), "--n", "1", "--tiny",
+                  "--kernels", "no_such_kernel"])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["entries"]["kernel:no_such_kernel"]["error"] \
+        == "unknown kernel"

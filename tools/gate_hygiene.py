@@ -1,0 +1,108 @@
+"""Gate-artifact hygiene check: the gate's memory must be committed.
+
+VERDICT r5 weak #7: ``BENCH_LADDER_BASELINES.json`` and
+``SCALING_SWEEP.json`` were left modified-but-uncommitted at round end —
+and the ladder file is the regression gate's MEMORY.  An uncommitted
+gate baseline is a gate that can drift silently: the next round compares
+against whatever happens to be on disk, not against what review saw.
+
+This check fails (exit 1) when
+
+- a REQUIRED gate-baseline artifact is missing or untracked, or
+- ANY gate-baseline artifact (required or optional, e.g. the
+  round-numbered ``KERNELBENCH_r*.json`` kernel-gate artifacts or
+  ``BENCH_VARIANCE.json``) is modified, staged-but-uncommitted, or —
+  for round-numbered artifacts — present but never added.
+
+It is wired into tier-1 (``tests/l0/test_gate_hygiene.py``), so a round
+cannot go green with dirty gate memory.  Best-effort on the VCS side:
+outside a git checkout (a tarball export, a read-only mirror) the check
+records that and passes — hygiene of a repo is meaningless without one.
+
+Usage: python tools/gate_hygiene.py [--repo DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+#: Artifacts that MUST exist and be tracked: the model-gate ladder
+#: memory and the scaling-law baseline.
+REQUIRED = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json")
+
+#: All gate-baseline patterns whose working-tree copies must match HEAD
+#: (round-numbered artifacts included: a fresh KERNELBENCH_rN.json is
+#: gate memory the moment it exists).
+PATTERNS = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json",
+            "BENCH_VARIANCE.json", "KERNELBENCH_r*.json",
+            "BENCH_r*.json")
+
+
+def _git(repo: str, *args: str) -> "str | None":
+    """stdout of a git command, or None when git/The repo is unavailable
+    (the best-effort contract)."""
+    try:
+        out = subprocess.run(
+            ["git", "-C", repo, *args], capture_output=True, text=True,
+            timeout=60)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    return out.stdout
+
+
+def check(repo: str = str(REPO)) -> dict:
+    """``{"ok": bool, "missing": [...], "untracked": [...],
+    "dirty": [...]}`` — see the module docstring for the rules."""
+    tracked_raw = _git(repo, "ls-files", "--", *PATTERNS)
+    if tracked_raw is None:
+        return {"ok": True, "skipped": "not a git checkout (or no git): "
+                                       "hygiene unverifiable", "missing": [],
+                "untracked": [], "dirty": []}
+    tracked = set(tracked_raw.split())
+    missing = [f for f in REQUIRED
+               if not (Path(repo) / f).exists() or f not in tracked]
+
+    # -uall: surface untracked round artifacts too (a new
+    # KERNELBENCH_rN.json must be committed, not parked)
+    status_raw = _git(repo, "status", "--porcelain", "-uall", "--",
+                      *PATTERNS) or ""
+    untracked, dirty = [], []
+    for line in status_raw.splitlines():
+        if len(line) < 4:
+            continue
+        code, path = line[:2], line[3:].strip()
+        if not any(fnmatch.fnmatch(Path(path).name, p) for p in PATTERNS):
+            continue
+        if code == "??":
+            untracked.append(path)
+        else:
+            dirty.append(path)
+    return {"ok": not (missing or untracked or dirty), "missing": missing,
+            "untracked": untracked, "dirty": dirty}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--repo", default=str(REPO))
+    args = ap.parse_args(argv)
+    verdict = check(args.repo)
+    print(json.dumps(verdict))
+    if not verdict["ok"]:
+        print("gate_hygiene: gate-baseline artifacts must be committed — "
+              f"missing/untracked {verdict['missing'] + verdict['untracked']},"
+              f" modified {verdict['dirty']}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,7 +37,9 @@ their numbers here reflect it.
 Bytes accounting per kernel (N = elements, fp32 flats unless noted):
 
 - ``fused_adam``    R p+m+v+g (16N)  W p+m+v (12N) + bf16 copy (2N)
-- ``lamb_stage1``   R g+p+m+v (16N)  W u+m+v (12N)
+- ``lamb_stage1``   R g+p+m+v (16N)  W u+m+v (12N) + the fused per-chunk
+  norm tables (with_norms — the production driver config; ~N/chunk·8 B,
+  accounted as 0)
 - ``lamb_stage2``   R p+u (8N)       W p (4N) + bf16 copy (2N)
 - ``mt_scale``      R 4N             W 4N
 - ``mt_axpby``      R 8N             W 4N
@@ -46,8 +48,25 @@ Bytes accounting per kernel (N = elements, fp32 flats unless noted):
 - ``layernorm_fwd_bwd`` adds R dy+x+stats, W dx (+ the dw/db partial
   reduction XLA appends) — accounted as 6S + fwd
 
+Geometry: every record carries the resolved block geometry (the shared
+selector's choice, ``apex_tpu.ops.pallas.geometry``) so the artifact
+states the shape it measured; ``--autotune`` sweeps each retunable
+kernel's geometry knob over its candidate ladder (short timings), picks
+the fastest, and records the sweep alongside the final full-length
+timing.
+
+Floors: ``KERNEL_FLOORS`` publishes a per-kernel roofline-fraction
+floor (the KERNELBENCH_r05 measured values, MFU_FLOORS convention:
+gate = floor × (1 − band); floors only move with BENCH_VARIANCE.json
+evidence — tests/l1/test_bench_units.py pins the no-ratchet-down rule).
+The ``floors`` block is always recorded; ``--assert-floors`` makes a
+violation exit 2 (the ``gate_exit_code`` pattern bench.py's absolute
+gates use).  Roofline fractions are only meaningful on TPU — off-chip
+the floors block records ``skipped`` and never gates.
+
 Usage: python tools/kernel_bench.py [--out KERNELBENCH.json]
        [--compare KERNELBENCH_rN.json] [--threshold 0.10] [--tiny]
+       [--autotune] [--assert-floors]
 """
 
 import argparse
@@ -107,8 +126,10 @@ def _time_scan(build, iters: int, trials: int = 3) -> float:
     return max(t_long - t_short, 1e-9) / (5 * iters)
 
 
-def bench_fused_adam(n: int):
-    from apex_tpu.ops.pallas.adam_kernel import packed_adam
+def bench_fused_adam(n: int, block_rows: "int | None" = None):
+    from apex_tpu.ops.pallas.adam_kernel import adam_geometry, packed_adam
+
+    geom = adam_geometry(n, with_copy=True, block_rows=block_rows)
 
     def build(k):
         key = jax.random.PRNGKey(0)
@@ -123,20 +144,23 @@ def bench_fused_adam(n: int):
                 p, m, v, _copy = packed_adam(
                     p, m, v, g, step_size=1e-3, beta1=0.9, beta2=0.999,
                     eps=1e-8, scale=1.0, weight_decay=0.0, eps_mode=1,
-                    p_copy_dtype=jnp.bfloat16)
+                    p_copy_dtype=jnp.bfloat16, block_rows=block_rows)
                 return (p, m, v), None
             (p, m, v), _ = jax.lax.scan(body, (p, m, v), None, length=k)
             return p
         return run, (p, m, v, g)
 
-    return build, 30.0 * n
+    return build, 30.0 * n, geom.asdict()
 
 
-def bench_lamb_stage1(n: int):
+def bench_lamb_stage1(n: int, chunks_per_block: "int | None" = None):
     from apex_tpu.ops.pallas.lamb_kernels import (grown_chunk,
-                                                  packed_lamb_stage1)
+                                                  packed_lamb_stage1,
+                                                  stage1_geometry)
 
     chunk = grown_chunk(n)   # the chunk the production driver packs at n
+    geom = stage1_geometry(n, chunk, chunks_per_block)
+
     def build(k):
         g = jax.random.normal(jax.random.PRNGKey(2), (n,), jnp.float32)
         p = jax.random.normal(jax.random.PRNGKey(3), (n,), jnp.float32)
@@ -147,22 +171,29 @@ def bench_lamb_stage1(n: int):
         def run(g, p, m, v):
             def body(carry, _):
                 g, m, v = carry
-                u, m, v = packed_lamb_stage1(
+                # with_norms: the production driver config — the fused
+                # per-chunk ‖p‖²/‖u‖² tables ride along
+                u, m, v, _psq, _usq = packed_lamb_stage1(
                     g, p, m, v, decay, beta1=0.9, beta2=0.999, eps=1e-6,
-                    inv_scale=1.0, bc1=1.0, bc2=1.0, chunk_size=chunk)
+                    inv_scale=1.0, bc1=1.0, bc2=1.0, chunk_size=chunk,
+                    chunks_per_block=chunks_per_block, with_norms=True)
                 return (u, m, v), None   # update feeds the next "grad"
             (u, m, v), _ = jax.lax.scan(body, (g, m, v), None, length=k)
             return u
         return run, (g, p, m, v)
 
-    return build, 28.0 * n
+    return build, 28.0 * n, geom.asdict()
 
 
-def bench_lamb_stage2(n: int):
+def bench_lamb_stage2(n: int, chunks_per_block: "int | None" = None):
     from apex_tpu.ops.pallas.lamb_kernels import (grown_chunk,
-                                                  packed_lamb_stage2)
+                                                  packed_lamb_stage2,
+                                                  stage2_geometry)
 
     chunk = grown_chunk(n)
+    geom = stage2_geometry(n, chunk, with_copy=True,
+                           chunks_per_block=chunks_per_block)
+
     def build(k):
         p = jax.random.normal(jax.random.PRNGKey(4), (n,), jnp.float32)
         u = jax.random.normal(jax.random.PRNGKey(5), (n,), jnp.float32)
@@ -172,13 +203,22 @@ def bench_lamb_stage2(n: int):
             def body(carry, _):
                 p2, _copy = packed_lamb_stage2(
                     carry, u, ratio, chunk_size=chunk,
-                    p_copy_dtype=jnp.bfloat16)
+                    p_copy_dtype=jnp.bfloat16,
+                    chunks_per_block=chunks_per_block)
                 return p2, None
             p, _ = jax.lax.scan(body, p, None, length=k)
             return p
         return run, (p, u)
 
-    return build, 14.0 * n
+    return build, 14.0 * n, geom.asdict()
+
+
+def _chunk_geometry(n: int) -> dict:
+    """Geometry of the fixed-chunk multi-tensor kernels (one CHUNK-sized
+    block per grid step, 128-lane view)."""
+    from apex_tpu.ops.pallas.geometry import StreamGeometry
+    return StreamGeometry(block_rows=CHUNK // 128, lanes=128,
+                          grid=n // CHUNK).asdict()
 
 
 def bench_mt_scale(n: int):
@@ -196,7 +236,7 @@ def bench_mt_scale(n: int):
             return x
         return run, (x,)
 
-    return build, 8.0 * n
+    return build, 8.0 * n, _chunk_geometry(n)
 
 
 def bench_mt_axpby(n: int):
@@ -215,7 +255,7 @@ def bench_mt_axpby(n: int):
             return x
         return run, (x, y)
 
-    return build, 12.0 * n
+    return build, 12.0 * n, _chunk_geometry(n)
 
 
 def bench_mt_sumsq(n: int):
@@ -240,12 +280,21 @@ def bench_mt_sumsq(n: int):
             return s
         return run, (x,)
 
-    return build, 4.0 * n
+    return build, 4.0 * n, _chunk_geometry(n)
 
 
-def bench_layernorm_fwd(rows: int, hidden: int):
-    from apex_tpu.normalization.fused_layer_norm import (
-        fused_layer_norm_affine)
+def _ln_geometry(rows: int, hidden: int,
+                 block_rows: "int | None" = None) -> dict:
+    from apex_tpu.ops.pallas.geometry import StreamGeometry
+    from apex_tpu.ops.pallas.layer_norm_kernels import fwd_block_rows
+    br = fwd_block_rows(rows, hidden, jnp.bfloat16, block_rows)
+    return StreamGeometry(block_rows=br, lanes=hidden,
+                          grid=-(-rows // br)).asdict()
+
+
+def bench_layernorm_fwd(rows: int, hidden: int,
+                        block_rows: "int | None" = None):
+    from apex_tpu.ops.pallas import layer_norm_kernels as lnk
 
     def build(k):
         x = jax.random.normal(jax.random.PRNGKey(10), (rows, hidden),
@@ -255,14 +304,18 @@ def bench_layernorm_fwd(rows: int, hidden: int):
 
         def run(x):
             def body(carry, _):
-                y = fused_layer_norm_affine(carry, w, b, hidden)
+                # the kernel itself (the wrapper's reshape is free) so the
+                # autotune sweep can pass the block override through
+                y, _mean, _inv = lnk._forward(carry, w, b, 1e-5, True,
+                                              block_rows=block_rows)
                 return y, None
             x, _ = jax.lax.scan(body, x, None, length=k)
             return x
         return run, (x,)
 
     s = rows * hidden
-    return build, 4.0 * s + 8.0 * rows
+    return build, 4.0 * s + 8.0 * rows, _ln_geometry(rows, hidden,
+                                                     block_rows)
 
 
 def bench_layernorm_fwd_bwd(rows: int, hidden: int):
@@ -287,35 +340,87 @@ def bench_layernorm_fwd_bwd(rows: int, hidden: int):
         return run, (x,)
 
     s = rows * hidden
-    return build, 10.0 * s + 16.0 * rows
+    # fwd geometry selected; bwd pinned at 128 rows (dγ/dβ accumulation
+    # order is part of the digest contract)
+    geom = _ln_geometry(rows, hidden)
+    geom["bwd_block_rows"] = 128
+    return build, 10.0 * s + 16.0 * rows, geom
 
 
-def run_suite(tiny: bool = False) -> dict:
-    # Buffers must EXCEED VMEM (~128 MB) or XLA keeps the scan carry
-    # resident and the measurement reads VMEM bandwidth, not HBM
-    # (observed: a 16 MB layer-norm carry "achieved" 18.7 TB/s).
+#: Per-kernel autotune knob + candidate ladder (the geometry axis each
+#: retuned kernel exposes as a static kwarg).  Fixed-chunk kernels have
+#: no knob and are never swept.
+AUTOTUNE_KNOBS = {
+    "fused_adam": ("block_rows", (8, 32, 64, 128, 256)),
+    "lamb_stage1": ("chunks_per_block", (1, 2, 4, 8, 16)),
+    "lamb_stage2": ("chunks_per_block", (1, 2, 4, 8, 16)),
+    "layernorm_fwd": ("block_rows", (64, 128, 256, 512)),
+}
+
+
+def suite_specs(tiny: bool = False) -> dict:
+    """``{name: (bench_fn, args, iters)}`` — THE kernel suite table,
+    shared with ``tools/bench_variance.py`` so a kernel added here (and
+    to ``KERNEL_FLOORS``) is automatically variance-measurable.
+
+    Buffers must EXCEED VMEM (~128 MB) or XLA keeps the scan carry
+    resident and the measurement reads VMEM bandwidth, not HBM
+    (observed: a 16 MB layer-norm carry "achieved" 18.7 TB/s).
+    difference-quotient span: 5*iters extra device-seconds must dwarf
+    the per-call RTT jitter (~10 ms); cheap kernels need more steps,
+    the ~20 ms LAMB stage-1 pass far fewer."""
     n = (1 << 16) if tiny else (1 << 26)            # 256 MB fp32 flats
     rows, hidden = (64, 512) if tiny else (1 << 17, 1024)  # 256 MB bf16
-    # difference-quotient span: 5*iters extra device-seconds must dwarf
-    # the per-call RTT jitter (~10 ms); cheap kernels need more steps,
-    # the ~20 ms LAMB stage-1 pass far fewer
+
     def it(fast):
         return 4 if tiny else fast
-    suite = {
-        "fused_adam": bench_fused_adam(n) + (it(60),),
-        "lamb_stage1": bench_lamb_stage1(n) + (it(30),),
-        "lamb_stage2": bench_lamb_stage2(n) + (it(40),),
-        "mt_scale": bench_mt_scale(n) + (it(150),),
-        "mt_axpby": bench_mt_axpby(n) + (it(150),),
-        "mt_sumsq": bench_mt_sumsq(n) + (it(300),),
-        "layernorm_fwd": bench_layernorm_fwd(rows, hidden) + (it(150),),
-        "layernorm_fwd_bwd": bench_layernorm_fwd_bwd(rows, hidden)
-        + (it(80),),
+    return {
+        "fused_adam": (bench_fused_adam, (n,), it(60)),
+        "lamb_stage1": (bench_lamb_stage1, (n,), it(30)),
+        "lamb_stage2": (bench_lamb_stage2, (n,), it(40)),
+        "mt_scale": (bench_mt_scale, (n,), it(150)),
+        "mt_axpby": (bench_mt_axpby, (n,), it(150)),
+        "mt_sumsq": (bench_mt_sumsq, (n,), it(300)),
+        "layernorm_fwd": (bench_layernorm_fwd, (rows, hidden), it(150)),
+        "layernorm_fwd_bwd": (bench_layernorm_fwd_bwd, (rows, hidden),
+                              it(80)),
     }
+
+
+def run_suite(tiny: bool = False, autotune: bool = False) -> dict:
+    n = (1 << 16) if tiny else (1 << 26)
+    rows, hidden = (64, 512) if tiny else (1 << 17, 1024)
+    suite = suite_specs(tiny)
     bw = _hbm_peak()
     kernels = {}
-    for name, (build, nbytes, iters) in suite.items():
+    for name, (fn, args, iters) in suite.items():
         try:
+            kw, sweep = {}, None
+            if autotune and name in AUTOTUNE_KNOBS:
+                knob, cands = AUTOTUNE_KNOBS[name]
+                sweep = {}
+                for cand in cands:
+                    # per-candidate isolation: one over-budget geometry
+                    # (e.g. a block whose double-buffered streams blow
+                    # VMEM and fail Mosaic) must cost only its sweep
+                    # entry, never the kernel's default-geometry record
+                    # or its floor-gate coverage
+                    try:
+                        build, _, _ = fn(*args, **{knob: cand})
+                        # short sweep timings (fewer steps, 2 trials):
+                        # the knob's effect is way above the quotient's
+                        # noise
+                        sec = _time_scan(build, max(iters // 3, 2),
+                                         trials=2)
+                        sweep[str(cand)] = round(sec * 1e3, 4)
+                    except Exception as e:  # noqa: BLE001
+                        sweep[str(cand)] = \
+                            {"error": f"{type(e).__name__}: {e}"[:120]}
+                timed = {c: ms for c, ms in sweep.items()
+                         if not isinstance(ms, dict)}
+                if timed:  # all-failed sweep -> selector's default
+                    kw = {knob: int(min(timed, key=timed.get))}
+            build, nbytes, geom = fn(*args, **kw)
             sec = _time_scan(build, iters)
             gbps = nbytes / sec / 1e9
             kernels[name] = {
@@ -324,13 +429,64 @@ def run_suite(tiny: bool = False) -> dict:
                 "gbps": round(gbps, 1),
                 "roofline_frac": round(gbps * 1e9 / bw, 4),
                 "iters": iters,
+                "geometry": geom,
             }
+            if sweep is not None:
+                kernels[name]["autotune"] = {"swept_ms": sweep,
+                                             "chosen": kw}
         except Exception as e:  # noqa: BLE001 - per-kernel isolation
             kernels[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
     return {"platform": jax.devices()[0].platform,
             "device_kind": getattr(jax.devices()[0], "device_kind", ""),
             "n_elements": n, "ln_shape": [rows, hidden],
             "hbm_gbps_peak": bw / 1e9, "kernels": kernels}
+
+
+#: Published per-kernel roofline-fraction floors — the KERNELBENCH_r05
+#: measured values rounded to two places (MFU_FLOORS convention: the
+#: floor is the bar, the band absorbs chip-day variance; the gate trips
+#: at floor × (1 − band)).  Floors RATCHET UP when a retune lands a
+#: measured gain and may only move DOWN with a BENCH_VARIANCE.json entry
+#: justifying the band (tests/l1/test_bench_units.py pins the rule).
+KERNEL_FLOOR_BAND = 0.05
+KERNEL_FLOORS = {
+    "fused_adam": 0.30,
+    "lamb_stage1": 0.17,
+    "lamb_stage2": 0.12,
+    "mt_scale": 0.75,
+    "mt_axpby": 0.80,
+    "mt_sumsq": 0.63,
+    "layernorm_fwd": 0.34,
+    "layernorm_fwd_bwd": 0.51,
+}
+
+
+def check_kernel_floors(kernels: dict) -> dict:
+    """Absolute per-kernel efficiency gate: every measured kernel with a
+    published floor must hold ``roofline_frac >= floor * (1 - band)``.
+
+    A gated kernel PRESENT in the map but errored (no roofline_frac —
+    e.g. a geometry change that fails Mosaic compilation) fails the gate
+    too, listed under ``errored``: a kernel that stops running entirely
+    is the worst regression, and a gate that skips it fails open.
+    Kernels absent from the map (partial runs) are merely not judged."""
+    checked, violations, errored = {}, [], []
+    for name, floor in KERNEL_FLOORS.items():
+        cur = kernels.get(name)
+        if cur is None:
+            continue
+        if not isinstance(cur, dict) or not cur.get("roofline_frac"):
+            errored.append(name)
+            continue
+        gate = floor * (1.0 - KERNEL_FLOOR_BAND)
+        ok = cur["roofline_frac"] >= gate
+        checked[name] = {"roofline_frac": cur["roofline_frac"],
+                         "floor": floor, "gate": round(gate, 4), "ok": ok}
+        if not ok:
+            violations.append(name)
+    return {"band": KERNEL_FLOOR_BAND, "checked": checked,
+            "violations": violations, "errored": errored,
+            "ok": not (violations or errored)}
 
 
 def compare_kernels(prior_path: str, kernels: dict,
@@ -375,6 +531,20 @@ def compare_kernels(prior_path: str, kernels: dict,
             "uncompared": uncompared, "ok": not regressions}
 
 
+def gate_exit_code(result: dict, compare_given: bool,
+                   assert_floors: bool) -> int:
+    """2 when the run must fail, else 0 — the bench.py pattern: the
+    floor gate is ABSOLUTE (needs no baseline) once armed via
+    ``--assert-floors``; the step-time delta gate stays opt-in via
+    ``--compare``."""
+    floors = result.get("floors") or {}
+    if assert_floors and not floors.get("ok", True):
+        return 2
+    if compare_given and not result.get("compare", {}).get("ok", True):
+        return 2
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=str(REPO / "KERNELBENCH.json"))
@@ -382,9 +552,26 @@ def main(argv=None):
     ap.add_argument("--threshold", type=float, default=0.10)
     ap.add_argument("--tiny", action="store_true",
                     help="tiny shapes (CPU smoke; numbers meaningless)")
+    ap.add_argument("--autotune", action="store_true",
+                    help="sweep each retunable kernel's geometry knob "
+                         "and record the sweep alongside the winner")
+    ap.add_argument("--assert-floors", action="store_true",
+                    help="exit 2 when any kernel sits under its "
+                         "published roofline-fraction floor (on-chip "
+                         "gate; off-TPU the floors block is skipped)")
     args = ap.parse_args(argv)
 
-    result = run_suite(tiny=args.tiny)
+    result = run_suite(tiny=args.tiny, autotune=args.autotune)
+    # The floors block is ALWAYS recorded; roofline fractions are only
+    # meaningful against a real HBM (off-chip the interpret-mode timings
+    # measure the host), so off-TPU it records skipped and never gates.
+    if result["platform"] == "tpu":
+        result["floors"] = check_kernel_floors(result["kernels"])
+    else:
+        result["floors"] = {
+            "ok": True,
+            "skipped": f"platform {result['platform']!r}: roofline "
+                       "fractions only meaningful on TPU"}
     if args.compare:
         result["compare"] = compare_kernels(
             args.compare, result["kernels"], args.threshold,
@@ -392,11 +579,14 @@ def main(argv=None):
                       "ln_shape": result["ln_shape"]})
     Path(args.out).write_text(json.dumps(result, indent=1))
     print(json.dumps(result))
-    if args.compare and not result["compare"]["ok"]:
-        print("kernel_bench: step-time regressions "
-              f"{result['compare']['regressions']}", file=sys.stderr)
-        return 2
-    return 0
+    rc = gate_exit_code(result, bool(args.compare), args.assert_floors)
+    if rc:
+        print("kernel_bench: gate failed: step-time regressions "
+              f"{result.get('compare', {}).get('regressions', [])}, "
+              "floor violations "
+              f"{result['floors'].get('violations', [])}",
+              file=sys.stderr)
+    return rc
 
 
 if __name__ == "__main__":
