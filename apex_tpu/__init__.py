@@ -26,6 +26,11 @@ This package provides the capabilities of NVIDIA Apex (reference:
   programs: donation, sharding, collective-volume, constant-capture, and
   O1-policy passes (no reference analog — a traced/compiled framework
   makes the guarantees checkable instead of structural).
+- :mod:`apex_tpu.resilience` — fault tolerance: crash-atomic
+  checksum-verified sharded checkpointing, seeded fault injection, and a
+  self-healing train loop with watchdog + divergence rewind (the
+  reference's resume contract, ``apex/fp16_utils/fp16_optimizer.py:298-359``,
+  extended to preemption / corruption / NaN-storm / hung-step inputs).
 
 Unlike the reference, which monkey-patches eager PyTorch, everything here is
 functional and jit-compiled: loss-scale state is a pytree carried through the
@@ -43,6 +48,7 @@ from apex_tpu import multi_tensor_apply
 from apex_tpu import normalization
 from apex_tpu import optimizers
 from apex_tpu import parallel
+from apex_tpu import resilience
 from apex_tpu import rnn
 
 #: The reference spells the RNN package ``apex.RNN`` (not auto-imported
@@ -62,6 +68,7 @@ __all__ = [
     "normalization",
     "optimizers",
     "parallel",
+    "resilience",
     "rnn",
     "RNN",
     "__version__",

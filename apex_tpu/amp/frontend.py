@@ -287,7 +287,8 @@ class Amp:
             return (AmpState(master, opt_state, state.scaler_states,
                              state.step + 1),
                     {"overflow": jnp.asarray(False),
-                     "loss_scale": jnp.asarray(1.0, jnp.float32)})
+                     "loss_scale": jnp.asarray(1.0, jnp.float32),
+                     "pinned_at_floor": jnp.asarray(False)})
 
         sstate = state.scaler_states[loss_id]
         if stashed_grads is not None:
@@ -306,9 +307,14 @@ class Amp:
             finite = jax.lax.pmin(finite.astype(jnp.int32), ax).astype(bool)
         state, overflow = self.update_scaler(state, loss_id, finite)
         new_state = self.step_if(state, grads_unscaled, overflow)
+        new_sstate = new_state.scaler_states[loss_id]
         return new_state, {
             "overflow": overflow,
-            "loss_scale": new_state.scaler_states[loss_id].loss_scale}
+            "loss_scale": new_sstate.loss_scale,
+            # device-side storm signal for the resilience sentinel: this
+            # overflow found the scale already at (or shrank it to) the
+            # min_loss_scale floor (scaler.pinned_at_floor)
+            "pinned_at_floor": self.scaler.pinned_at_floor(new_sstate)}
 
     # ------------------------------------------------------------------
     # composable pieces for multi-loss / multi-optimizer topologies
@@ -412,7 +418,9 @@ class Amp:
             return new_state, {
                 "overflow": info["overflow"],
                 "loss_scale": tuple(jnp.asarray(1.0, jnp.float32)
-                                    for _ in new_state.scaler_states)}
+                                    for _ in new_state.scaler_states),
+                "pinned_at_floor": tuple(jnp.asarray(False)
+                                         for _ in new_state.scaler_states)}
 
         # Callers scale every loss at iteration entry, so unscale against the
         # entry-time scaler states even as the per-loss updates land below
@@ -439,6 +447,8 @@ class Amp:
             "overflow": any_overflow,
             "loss_scale": tuple(s.loss_scale
                                 for s in new_state.scaler_states),
+            "pinned_at_floor": tuple(self.scaler.pinned_at_floor(s)
+                                     for s in new_state.scaler_states),
         }
 
 

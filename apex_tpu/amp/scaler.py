@@ -84,6 +84,23 @@ class LossScaler:
             unskipped=jnp.asarray(0, jnp.int32),
         )
 
+    @property
+    def floor(self) -> float:
+        """The effective minimum scale of the dynamic transition —
+        ``min_loss_scale`` or the 1.0 default :meth:`update` clamps to."""
+        return self.min_loss_scale if self.min_loss_scale is not None else 1.0
+
+    def pinned_at_floor(self, state: LossScaleState) -> jax.Array:
+        """Device-side flag: the dynamic scale sits at its floor, i.e. the
+        next overflow CANNOT shrink it further.  ``overflow AND pinned``
+        sustained for K steps is the divergence sentinel's signal that
+        the run is in an overflow *storm*, not a normal transient skip
+        (:mod:`apex_tpu.resilience.loop`).  Always False for a static
+        scale (it never moves, so "pinned" carries no information)."""
+        if not self.dynamic:
+            return jnp.asarray(False)
+        return state.loss_scale <= jnp.asarray(self.floor, jnp.float32)
+
     # -- hot-loop ops (all traceable) ------------------------------------
 
     def scale_loss(self, loss: jax.Array, state: LossScaleState) -> jax.Array:
@@ -135,10 +152,8 @@ class LossScaler:
         if not self.dynamic:
             return state, overflow
 
-        min_scale = (self.min_loss_scale
-                     if self.min_loss_scale is not None else 1.0)
         shrunk = jnp.maximum(state.loss_scale / self.scale_factor,
-                             jnp.asarray(min_scale, jnp.float32))
+                             jnp.asarray(self.floor, jnp.float32))
         unskipped = jnp.where(overflow, 0, state.unskipped + 1)
         window_hit = unskipped >= self.scale_window
         grown = jnp.minimum(state.loss_scale * self.scale_factor,

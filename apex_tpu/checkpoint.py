@@ -8,7 +8,14 @@ checkpoint — the scaler states in ``_amp_state.loss_scalers`` were lost on
 restart (SURVEY.md §5.4).  This module closes that gap: the whole
 :class:`~apex_tpu.amp.frontend.AmpState` (fp32 masters, optimizer state,
 every loss scaler, step counter) plus arbitrary extras (e.g. BatchNorm
-running stats, epoch counters) round-trips through orbax.
+running stats, epoch counters) round-trips through the durable snapshot
+layer (:mod:`apex_tpu.resilience.durable`): crash-atomic commits
+(tmp-dir + fsync + rename), per-leaf sha256 checksums in a manifest,
+async save off the step path, and restore that skips a corrupted or
+truncated snapshot in favor of the last good one.  Leaves are gathered
+to full host arrays on save and placed onto the *template's* shardings
+on restore, so a state saved sharded on an 8-device mesh restores
+bit-identically onto a 4-device mesh (or a single device).
 
 App-level pattern (the reference's epoch checkpointing,
 ``examples/imagenet/main_amp.py:170-185,244-254``)::
@@ -20,33 +27,78 @@ App-level pattern (the reference's epoch checkpointing,
 
 from __future__ import annotations
 
-import os
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Iterable, Optional, Tuple
 
 import jax
 import numpy as np
 
 from apex_tpu.amp.frontend import AmpState
 from apex_tpu.amp.scaler import LossScaleState
+from apex_tpu.resilience.durable import DurableCheckpointManager
+
+
+def payload_template(state: AmpState,
+                     extras: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
+    """The nested-dict *layout* of a checkpoint payload, with the state's
+    own leaves (no host transfer) — what the durable layer flattens to
+    name leaves, and what :func:`state_dict` materializes."""
+    return {
+        "master_params": state.master_params,
+        "opt_state": state.opt_state,
+        "scaler_states": [
+            {"loss_scale": s.loss_scale, "unskipped": s.unskipped}
+            for s in state.scaler_states],
+        "step": state.step,
+        # Always present (possibly empty) so save/restore tree structures
+        # match whenever both sides pass the same extras template.
+        "extras": extras if extras else {},
+    }
 
 
 def state_dict(state: AmpState, extras: Optional[Dict[str, Any]] = None
                ) -> Dict[str, Any]:
     """AmpState → plain nested dict (the ``amp.state_dict`` the reference
     snapshot lacked).  Everything is converted to host numpy so the result
-    pickles / serializes with any backend."""
-    return {
-        "master_params": jax.tree.map(np.asarray, state.master_params),
-        "opt_state": jax.tree.map(np.asarray, state.opt_state),
-        "scaler_states": [
-            {"loss_scale": np.asarray(s.loss_scale),
-             "unskipped": np.asarray(s.unskipped)}
-            for s in state.scaler_states],
-        "step": np.asarray(state.step),
-        # Always present (possibly empty) so save/restore tree structures
-        # match whenever both sides pass the same extras template.
-        "extras": jax.tree.map(np.asarray, extras if extras else {}),
-    }
+    pickles / serializes with any backend.  For a sharded (but fully
+    addressable) state this gathers each leaf to one full host array —
+    the layout-free form the durable snapshot layer stores."""
+    return jax.tree.map(np.asarray, payload_template(state, extras))
+
+
+def check_same_structure(saved_keys: Iterable[str],
+                         template_keys: Iterable[str],
+                         context: str = "checkpoint") -> None:
+    """Raise a debuggable error when saved and template leaf sets differ.
+
+    The reference's ``load_state_dict`` had the same structural contract
+    (optimizer/model constructed identically, ``fp16_optimizer.py:330-359``)
+    but a mismatch surfaced as a cryptic zip/tree error.  Here the first
+    diverging tree path is named explicitly, for both directions."""
+    saved, tmpl = set(saved_keys), set(template_keys)
+    if saved == tmpl:
+        return
+    missing = sorted(tmpl - saved)      # template expects, checkpoint lacks
+    extra = sorted(saved - tmpl)        # checkpoint has, template lacks
+    first = (missing + extra)[0] if missing else extra[0]
+    detail = []
+    if missing:
+        detail.append(f"missing from {context}: {missing[:3]}"
+                      + (" ..." if len(missing) > 3 else ""))
+    if extra:
+        detail.append(f"not in template: {extra[:3]}"
+                      + (" ..." if len(extra) > 3 else ""))
+    raise ValueError(
+        f"structural mismatch between {context} and template at leaf "
+        f"{first!r} ({'; '.join(detail)}; {len(saved)} saved vs "
+        f"{len(tmpl)} template leaves).  The model/optimizer must be "
+        "constructed identically to the run that saved — the reference's "
+        "load_state_dict contract (fp16_optimizer.py:330-359).")
+
+
+def _leaf_keys(tree: Any) -> Iterable[str]:
+    return (jax.tree_util.keystr(p)
+            for p, _ in jax.tree_util.tree_leaves_with_path(tree))
 
 
 def load_state_dict(template: AmpState, d: Dict[str, Any]
@@ -55,10 +107,16 @@ def load_state_dict(template: AmpState, d: Dict[str, Any]
     (e.g. a freshly ``Amp.init``-ed state) supplies the tree structure and
     dtypes; saved leaves are matched structurally, so the optimizer and
     model must be constructed identically — the same contract as the
-    reference's ``load_state_dict`` (``fp16_optimizer.py:330-359``)."""
-    def like(saved, ref):
+    reference's ``load_state_dict`` (``fp16_optimizer.py:330-359``).  A
+    structural mismatch raises naming the first diverging leaf path."""
+    target = payload_template(template)
+    del target["extras"]    # extras follow their own (optional) contract
+    saved = {k: d.get(k) for k in target}
+    check_same_structure(_leaf_keys(saved), _leaf_keys(target))
+
+    def like(saved_tree, ref):
         return jax.tree.map(
-            lambda s, r: jax.numpy.asarray(s, dtype=r.dtype), saved, ref)
+            lambda s, r: jax.numpy.asarray(s, dtype=r.dtype), saved_tree, ref)
 
     scalers = tuple(
         LossScaleState(
@@ -76,62 +134,16 @@ def load_state_dict(template: AmpState, d: Dict[str, Any]
     return state, d.get("extras", {})
 
 
-class CheckpointManager:
-    """Orbax-backed epoch/step checkpointing with retention.
+class CheckpointManager(DurableCheckpointManager):
+    """Durable epoch/step checkpointing with retention.
 
     Persists the full amp training state; ``restore`` resumes the scaler
     exactly (loss scale + unskipped counter), which the reference could
-    not do.
+    not do.  Backed by :class:`~apex_tpu.resilience.durable.
+    DurableCheckpointManager` (crash-atomic commit, per-leaf checksums,
+    async save, corrupted-snapshot fallback, mesh-reshape restore); this
+    subclass only pins the historical constructor signature.
     """
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
-        import orbax.checkpoint as ocp
-        self._ocp = ocp
-        self._dir = os.path.abspath(directory)
-        os.makedirs(self._dir, exist_ok=True)
-        self._mgr = ocp.CheckpointManager(
-            self._dir,
-            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
-
-    def save(self, step: int, state: AmpState,
-             extras: Optional[Dict[str, Any]] = None) -> None:
-        """Write asynchronously — the training loop is not blocked on disk
-        (call :meth:`wait` / :meth:`close` before exiting, as the imagenet
-        example does; ``restore`` waits automatically)."""
-        payload = state_dict(state, extras)
-        self._mgr.save(int(step),
-                       args=self._ocp.args.StandardSave(payload))
-
-    def wait(self) -> None:
-        """Block until any in-flight async save has committed."""
-        self._mgr.wait_until_finished()
-
-    def close(self) -> None:
-        self._mgr.wait_until_finished()
-        self._mgr.close()
-
-    def latest_step(self) -> Optional[int]:
-        self._mgr.wait_until_finished()
-        return self._mgr.latest_step()
-
-    def restore(self, template: AmpState,
-                step: Optional[int] = None,
-                extras: Optional[Dict[str, Any]] = None
-                ) -> Tuple[AmpState, Dict[str, Any]]:
-        """Restore the given (or latest) step.
-
-        ``extras`` must be a structure template matching what the
-        checkpoint was *saved* with (same keys/shapes; values are ignored)
-        — the same structural contract as ``load_state_dict``.  A save
-        without extras restores without them.
-        """
-        self._mgr.wait_until_finished()
-        if step is None:
-            step = self._mgr.latest_step()
-            if step is None:
-                raise FileNotFoundError(
-                    f"no checkpoint found in {self._dir}")
-        target = state_dict(template, extras)
-        payload = self._mgr.restore(
-            int(step), args=self._ocp.args.StandardRestore(target))
-        return load_state_dict(template, payload)
+    def __init__(self, directory: str, max_to_keep: int = 3, **kwargs: Any):
+        super().__init__(directory, max_to_keep=max_to_keep, **kwargs)

@@ -20,17 +20,33 @@ to spawn per chip on a Cloud TPU VM.  This module provides:
 
 from __future__ import annotations
 
+import inspect
 import os
 import subprocess
 import sys
+import time
 from typing import List, Optional, Sequence
 
 import jax
 
 
+class ClusterInitError(RuntimeError):
+    """Cluster formation failed within the configured timeout/retry
+    budget — with the expected world shape and candidate missing ranks
+    in the message, instead of an indefinite hang."""
+
+
+def _env_float(name: str, default: float) -> float:
+    val = os.environ.get(name)
+    return float(val) if val not in (None, "") else default
+
+
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
-               process_id: Optional[int] = None) -> None:
+               process_id: Optional[int] = None,
+               timeout_s: Optional[float] = None,
+               retries: Optional[int] = None,
+               backoff_s: Optional[float] = None) -> None:
     """Initialize multi-host JAX (the ``torch.distributed.launch`` /
     ``multiproc.py`` analog).
 
@@ -38,6 +54,16 @@ def initialize(coordinator_address: Optional[str] = None,
     ``WORLD_SIZE``, ``RANK`` — the reference's env contract,
     ``_amp_state.py:38-40``); on Cloud TPU all three are auto-detected and
     ``jax.distributed.initialize()`` needs no arguments.
+
+    Unlike the raw ``jax.distributed.initialize`` (which blocks until its
+    coordinator timeout) this call is **bounded**: each attempt runs with
+    ``timeout_s`` (env ``APEX_TPU_INIT_TIMEOUT_S``, default 300) and is
+    retried ``retries`` times (``APEX_TPU_INIT_RETRIES``, default 2) with
+    exponential backoff starting at ``backoff_s``
+    (``APEX_TPU_INIT_BACKOFF_S``, default 5) — a peer that never arrives
+    (the r02 failure shape: a killed worker whose lease was never
+    released) surfaces as a :class:`ClusterInitError` naming the ranks
+    that can be missing, not as a wedged process.
     """
     kwargs = {}
     addr = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
@@ -49,7 +75,52 @@ def initialize(coordinator_address: Optional[str] = None,
     rank = process_id if process_id is not None else os.environ.get("RANK")
     if rank is not None and rank != "":  # RANK="" falls through to
         kwargs["process_id"] = int(rank)  # auto-detection like the others
-    jax.distributed.initialize(**kwargs)
+
+    timeout_s = timeout_s if timeout_s is not None else \
+        _env_float("APEX_TPU_INIT_TIMEOUT_S", 300.0)
+    retries = int(retries if retries is not None else
+                  _env_float("APEX_TPU_INIT_RETRIES", 2))
+    backoff_s = backoff_s if backoff_s is not None else \
+        _env_float("APEX_TPU_INIT_BACKOFF_S", 5.0)
+
+    # older jax has no per-call timeout knob; feature-detect once
+    if "initialization_timeout" in inspect.signature(
+            jax.distributed.initialize).parameters:
+        kwargs["initialization_timeout"] = max(1, int(timeout_s))
+
+    attempts = retries + 1
+    last_error: Optional[BaseException] = None
+    for attempt in range(attempts):
+        try:
+            jax.distributed.initialize(**kwargs)
+            return
+        except (RuntimeError, OSError, ValueError, jax.errors.JaxRuntimeError
+                ) as e:
+            # a double-initialize is a programming error, not weather:
+            # retrying it burns the whole backoff schedule and then
+            # reports a phantom missing-peer problem
+            if "already initialized" in str(e).lower():
+                raise
+            last_error = e
+            if attempt + 1 < attempts:
+                time.sleep(backoff_s * (2.0 ** attempt))
+
+    n = kwargs.get("num_processes")
+    r = kwargs.get("process_id")
+    if n is not None:
+        others = sorted(set(range(int(n))) - ({int(r)} if r is not None
+                                              else set()))
+        shape = (f"this is rank {r} of {n}; the missing peer(s) are among "
+                 f"ranks {others}" if r is not None else
+                 f"expected {n} processes (ranks {others})")
+    else:
+        shape = "world size unknown (no WORLD_SIZE/num_processes given)"
+    raise ClusterInitError(
+        f"cluster init failed after {attempts} attempt(s) x {timeout_s:g}s "
+        f"(coordinator {kwargs.get('coordinator_address', '<auto>')}): "
+        f"{shape}.  Last error: {last_error!r}.  Tune via "
+        "APEX_TPU_INIT_TIMEOUT_S / APEX_TPU_INIT_RETRIES / "
+        "APEX_TPU_INIT_BACKOFF_S.") from last_error
 
 
 def _free_port() -> int:

@@ -1,0 +1,43 @@
+"""apex_tpu.resilience — fault tolerance for training at production scale.
+
+The reference's resume story was "save fp32 masters + scaler state and
+restart exactly" (``apex/fp16_utils/fp16_optimizer.py:298-359``); this
+subsystem extends that contract to the failure modes a long-lived TPU
+run actually meets (the r02 chip-lease wedge, preemptions, NaN storms,
+flaky checkpoint IO):
+
+- :mod:`~apex_tpu.resilience.durable` — crash-atomic, checksum-verified,
+  shard-portable checkpointing (:class:`DurableCheckpointManager`);
+- :mod:`~apex_tpu.resilience.faults` — seeded, composable fault
+  injection (:class:`FaultInjector` and the fault dataclasses);
+- :mod:`~apex_tpu.resilience.loop` — the self-healing train loop
+  (:func:`run_resilient`: watchdog, IO retry, divergence rewind);
+- :mod:`~apex_tpu.resilience.incidents` — the machine-checkable incident
+  artifact schema shared with ``tools/gate_hygiene.py``.
+"""
+
+from apex_tpu.resilience.durable import (CheckpointCorruptError,
+                                         DurableCheckpointManager,
+                                         read_snapshot, verify_snapshot,
+                                         write_snapshot)
+from apex_tpu.resilience.faults import (CorruptCheckpoint, FaultInjector,
+                                        FlakyIO, HangStep, NaNStorm,
+                                        Preempt, SimulatedPreemption,
+                                        SlowIO)
+from apex_tpu.resilience.incidents import (make_incident, validate_incident,
+                                           validate_incident_file,
+                                           write_incident)
+from apex_tpu.resilience.loop import (DivergenceError, ResilienceConfig,
+                                      RunResult, WatchdogTimeout,
+                                      retry_io, run_resilient)
+
+__all__ = [
+    "CheckpointCorruptError", "DurableCheckpointManager", "read_snapshot",
+    "verify_snapshot", "write_snapshot",
+    "CorruptCheckpoint", "FaultInjector", "FlakyIO", "HangStep", "NaNStorm",
+    "Preempt", "SimulatedPreemption", "SlowIO",
+    "make_incident", "validate_incident", "validate_incident_file",
+    "write_incident",
+    "DivergenceError", "ResilienceConfig", "RunResult", "WatchdogTimeout",
+    "retry_io", "run_resilient",
+]

@@ -1,0 +1,402 @@
+"""Durable, crash-atomic, shard-portable checkpointing.
+
+The reference's whole resume contract was "save fp32 masters + scaler
+state and restart exactly" (``apex/fp16_utils/fp16_optimizer.py:298-359``)
+— written with one ``torch.save`` that a preemption mid-write turns into
+an unreadable pickle, silently.  This manager makes the failure modes
+first-class:
+
+- **crash-atomic commit**: a snapshot is staged in a ``.tmp-*`` sibling
+  directory, every file is fsync'd, the manifest is written last, the
+  directory fsync'd, then atomically renamed into place and the parent
+  directory fsync'd.  A crash at ANY point leaves either the previous
+  snapshots untouched or an ignorable tmp dir — never a half-checkpoint
+  that parses.
+- **per-leaf checksums**: the manifest records a sha256 per leaf file;
+  :meth:`restore` verifies every one and *skips* a corrupted/truncated
+  snapshot in favor of the newest older snapshot that verifies (the
+  report of what was skipped and why is kept on ``last_restore``).
+- **async save off the step path**: :meth:`save` gathers leaves to host
+  on the calling thread (a donated-buffer train step may invalidate the
+  device arrays the moment the next step is dispatched, so the gather
+  cannot be deferred) and enqueues the host payload to a writer thread —
+  serialization, fsync and retention run off the training thread.
+  ``wait()`` re-raises any background failure.
+- **shard-portable**: leaves are gathered to full host arrays on save
+  (any fully-addressable sharding), and on restore each leaf is placed
+  onto the *template* leaf's sharding — so a state saved FSDP-sharded on
+  an 8-device mesh restores bit-identically onto a 4-device mesh, a
+  single device, or any other layout the template carries (VERDICT
+  item 3).  Multi-host (non-addressable) arrays are out of scope here;
+  gather-per-host frameworks should shard the *directory*, not the file.
+
+Layout::
+
+    dir/
+      step_00000012/
+        manifest.json      # {"format":1,"step":12,"leaves":{keystr: {...}}}
+        leaf_00000.npy ...
+      step_00000009/ ...
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import queue
+import shutil
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+MANIFEST = "manifest.json"
+_STEP_PREFIX = "step_"
+FORMAT = 1
+
+
+def _step_dirname(step: int) -> str:
+    return f"{_STEP_PREFIX}{int(step):08d}"
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _flatten_payload(payload: Any) -> List[Tuple[str, np.ndarray]]:
+    """``state_dict``-style nested dict → ``[(keystr, host array)]`` in
+    canonical (tree-flatten) order."""
+    flat = jax.tree_util.tree_leaves_with_path(payload)
+    return [(jax.tree_util.keystr(path), np.asarray(leaf))
+            for path, leaf in flat]
+
+
+def write_snapshot(directory: str, step: int, payload: Any,
+                   fsync: bool = True) -> str:
+    """Stage + atomically commit one snapshot; returns the final path."""
+    final = os.path.join(directory, _step_dirname(step))
+    tmp = os.path.join(directory,
+                       f".tmp-{_step_dirname(step)}-{os.getpid()}-"
+                       f"{threading.get_ident()}")
+    os.makedirs(tmp)
+    try:
+        leaves: Dict[str, Dict[str, Any]] = {}
+        for i, (key, arr) in enumerate(_flatten_payload(payload)):
+            fname = f"leaf_{i:05d}.npy"
+            buf = io.BytesIO()
+            np.save(buf, arr, allow_pickle=False)
+            raw = buf.getvalue()
+            with open(os.path.join(tmp, fname), "wb") as f:
+                f.write(raw)
+                if fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+            leaves[key] = {
+                "file": fname,
+                "sha256": hashlib.sha256(raw).hexdigest(),
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+                "bytes": len(raw),
+            }
+        manifest = {"format": FORMAT, "step": int(step), "leaves": leaves}
+        with open(os.path.join(tmp, MANIFEST), "w") as f:
+            json.dump(manifest, f, indent=1)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        if fsync:
+            _fsync_dir(tmp)
+        if os.path.exists(final):  # re-save of a step: replace wholesale
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+        if fsync:
+            _fsync_dir(directory)
+        return final
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def verify_snapshot(path: str) -> Tuple[bool, List[str]]:
+    """Checksum-verify one snapshot directory (manifest + every leaf)."""
+    problems: List[str] = []
+    mpath = os.path.join(path, MANIFEST)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, [f"manifest unreadable: {e}"]
+    if manifest.get("format") != FORMAT:
+        return False, [f"unknown snapshot format {manifest.get('format')!r}"]
+    for key, meta in manifest.get("leaves", {}).items():
+        fpath = os.path.join(path, meta["file"])
+        try:
+            with open(fpath, "rb") as f:
+                raw = f.read()
+        except OSError as e:
+            problems.append(f"{key}: leaf file unreadable: {e}")
+            continue
+        if hashlib.sha256(raw).hexdigest() != meta["sha256"]:
+            problems.append(
+                f"{key}: checksum mismatch in {meta['file']} "
+                f"({len(raw)} bytes on disk, {meta['bytes']} expected)")
+    return not problems, problems
+
+
+def read_snapshot(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """Load a snapshot, verifying every checksum as it reads — one pass
+    of IO and hashing; ANY malformation (unreadable/alien manifest,
+    missing leaf file, checksum mismatch, unparsable npy) raises
+    :class:`CheckpointCorruptError` so callers have a single
+    this-snapshot-is-bad signal to fall back on."""
+    try:
+        with open(os.path.join(path, MANIFEST)) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(f"{path}: manifest unreadable: {e}")
+    if manifest.get("format") != FORMAT:
+        raise CheckpointCorruptError(
+            f"{path}: unknown snapshot format {manifest.get('format')!r}")
+    values: Dict[str, np.ndarray] = {}
+    for key, meta in manifest.get("leaves", {}).items():
+        try:
+            with open(os.path.join(path, meta["file"]), "rb") as f:
+                raw = f.read()
+        except OSError as e:
+            raise CheckpointCorruptError(
+                f"{path}: {key}: leaf file unreadable: {e}")
+        if hashlib.sha256(raw).hexdigest() != meta["sha256"]:
+            raise CheckpointCorruptError(
+                f"{path}: {key}: checksum mismatch in {meta['file']} "
+                f"({len(raw)} bytes on disk, {meta['bytes']} expected)")
+        try:
+            values[key] = np.load(io.BytesIO(raw), allow_pickle=False)
+        except ValueError as e:
+            raise CheckpointCorruptError(
+                f"{path}: {key}: unparsable npy payload: {e}")
+    return values, manifest
+
+
+class CheckpointCorruptError(RuntimeError):
+    """No snapshot in the directory survived checksum verification."""
+
+
+class DurableCheckpointManager:
+    """Crash-atomic checkpointing of :class:`~apex_tpu.amp.AmpState` with
+    retention, async save, checksum-verified restore with fallback, and
+    mesh-reshape restore (see module docstring).
+
+    Drop-in for the historical (orbax-backed) manager's API::
+
+        mgr = DurableCheckpointManager(dir, max_to_keep=3)
+        mgr.save(step, state, extras={"epoch": e})   # async, off-step-path
+        state, extras = mgr.restore(template, extras=...)
+        mgr.wait(); mgr.close()
+
+    ``io_hook(op)`` (op in ``{"save", "restore"}``) runs before each IO
+    operation — the fault injector's seam for slow/flaky IO.
+    ``on_commit(step, path)`` runs after a snapshot commits — the
+    injector's seam for post-commit corruption, and a place to publish
+    "checkpoint landed" metrics.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 async_save: bool = True, fsync: bool = True,
+                 io_hook: Optional[Callable[[str], None]] = None,
+                 on_commit: Optional[Callable[[int, str], None]] = None,
+                 io_retries: int = 3, io_backoff_s: float = 0.05):
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._max_to_keep = int(max_to_keep)
+        self._io_retries = int(io_retries)
+        self._io_backoff_s = float(io_backoff_s)
+        self._fsync = fsync
+        self._io_hook = io_hook
+        self._on_commit = on_commit
+        self._async = async_save
+        self._queue: "queue.Queue" = queue.Queue()
+        self._errors: List[BaseException] = []
+        self._worker: Optional[threading.Thread] = None
+        self._closed = False
+        self.last_restore: Optional[Dict[str, Any]] = None
+        # a crash mid-stage leaves .tmp-* siblings; they are dead weight
+        for name in os.listdir(self._dir):
+            if name.startswith(".tmp-"):
+                shutil.rmtree(os.path.join(self._dir, name),
+                              ignore_errors=True)
+
+    # -- background writer ------------------------------------------------
+    def _ensure_worker(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._drain, name="apex-tpu-ckpt-writer", daemon=True)
+            self._worker.start()
+
+    def _drain(self) -> None:
+        while True:
+            job = self._queue.get()
+            if job is None:
+                self._queue.task_done()
+                return
+            step, payload = job
+            try:
+                self._commit_with_retry(step, payload)
+            except BaseException as e:  # surfaced on wait()/next save()
+                self._errors.append(e)
+            finally:
+                self._queue.task_done()
+
+    def _raise_pending(self) -> None:
+        if self._errors:
+            err = self._errors.pop(0)
+            raise RuntimeError(
+                f"background checkpoint save failed: {err!r}") from err
+
+    # -- API ---------------------------------------------------------------
+    def save(self, step: int, state: Any,
+             extras: Optional[Dict[str, Any]] = None) -> None:
+        """Snapshot; the training loop is not blocked on disk.  The
+        device→host gather happens HERE, synchronously — under a
+        ``donate_argnums`` train step the device buffers may be
+        invalidated the moment the next step is dispatched, so it cannot
+        be deferred to the worker.  Serialization/fsync/retention run on
+        the writer thread (call :meth:`wait` / :meth:`close` before
+        exiting; ``restore``/``latest_step`` wait automatically)."""
+        if self._closed:
+            raise RuntimeError("CheckpointManager is closed")
+        self._raise_pending()
+        from apex_tpu import checkpoint as ckpt
+        payload = ckpt.state_dict(state, extras)   # host copy, race-free
+        if not self._async:
+            self._commit_with_retry(int(step), payload)
+            return
+        self._ensure_worker()
+        self._queue.put((int(step), payload))
+
+    def _commit_with_retry(self, step: int, payload: Any) -> str:
+        # transient IO (OSError) retries here, wherever the commit runs
+        # (writer thread in async mode, the caller in sync mode)
+        from apex_tpu.resilience.loop import retry_io
+        return retry_io(lambda: self._commit(step, payload),
+                        retries=self._io_retries,
+                        backoff_s=self._io_backoff_s)
+
+    def _commit(self, step: int, payload: Any) -> str:
+        if self._io_hook is not None:
+            self._io_hook("save")
+        path = write_snapshot(self._dir, step, payload, fsync=self._fsync)
+        self._retain()
+        if self._on_commit is not None:
+            self._on_commit(step, path)
+        return path
+
+    def _retain(self) -> None:
+        steps = self.all_steps()
+        for s in steps[:-self._max_to_keep] if self._max_to_keep > 0 else []:
+            shutil.rmtree(os.path.join(self._dir, _step_dirname(s)),
+                          ignore_errors=True)
+
+    def wait(self) -> None:
+        """Block until every queued save has committed; re-raise the
+        first background failure."""
+        self._queue.join()
+        self._raise_pending()
+
+    def close(self) -> None:
+        self.wait()
+        self._closed = True
+        if self._worker is not None and self._worker.is_alive():
+            self._queue.put(None)           # shut the writer down; a
+            self._worker.join(timeout=5.0)  # closed manager must not
+        self._worker = None                 # leak a parked thread
+
+    def all_steps(self) -> List[int]:
+        """Committed snapshot steps, oldest → newest (no verification)."""
+        steps = []
+        for name in os.listdir(self._dir):
+            if name.startswith(_STEP_PREFIX):
+                try:
+                    steps.append(int(name[len(_STEP_PREFIX):]))
+                except ValueError:
+                    pass
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        self.wait()
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def restore(self, template: Any, step: Optional[int] = None,
+                extras: Optional[Dict[str, Any]] = None) -> Tuple[Any, Dict]:
+        """Restore the given (or newest *verifying*) step.
+
+        Every leaf checksum is verified; a snapshot that fails — truncated
+        by a preemption, corrupted on disk — is skipped and the next older
+        one tried (unless ``step`` pins one explicitly, which fails hard).
+        ``template`` supplies structure, dtypes AND placement: each leaf
+        is ``device_put`` onto the template leaf's sharding, which is what
+        makes 8-device-saved → 4-device-restored work.  ``last_restore``
+        records the chosen step and any skipped snapshots.
+        """
+        from apex_tpu import checkpoint as ckpt
+        self.wait()
+        if self._io_hook is not None:
+            self._io_hook("restore")
+        candidates = [int(step)] if step is not None \
+            else list(reversed(self.all_steps()))
+        if not candidates:
+            raise FileNotFoundError(f"no checkpoint found in {self._dir}")
+        skipped: List[Dict[str, Any]] = []
+        for s in candidates:
+            path = os.path.join(self._dir, _step_dirname(s))
+            if not os.path.isdir(path):
+                if step is not None:
+                    raise FileNotFoundError(f"no snapshot for step {s} in "
+                                            f"{self._dir}")
+                continue
+            try:    # read verifies every checksum in the same IO pass
+                values, _manifest = read_snapshot(path)
+            except CheckpointCorruptError as e:
+                if step is not None:
+                    raise
+                skipped.append({"step": s, "problems": [str(e)]})
+                continue
+            target = ckpt.payload_template(template, extras)
+            flat_target = jax.tree_util.tree_flatten_with_path(target)
+            target_keys = [jax.tree_util.keystr(p)
+                           for p, _ in flat_target[0]]
+            ckpt.check_same_structure(set(values), set(target_keys),
+                                      context=f"snapshot step {s}")
+            payload = jax.tree_util.tree_unflatten(
+                flat_target[1], [values[k] for k in target_keys])
+            state, ex = ckpt.load_state_dict(template, payload)
+            state = _place_like(state, template)
+            ex = _place_like(ex, extras) if extras else ex
+            self.last_restore = {"step": s, "skipped": skipped}
+            return state, ex
+        raise CheckpointCorruptError(
+            f"every snapshot in {self._dir} failed verification: {skipped}")
+
+
+def _place_like(values: Any, template: Any) -> Any:
+    """Place each restored leaf onto its template leaf's sharding — full
+    arrays + template placement is the whole mesh-reshape story.  Only
+    leaves the template explicitly commits to a mesh (``NamedSharding``)
+    are placed; everything else stays an uncommitted device array, so a
+    restored state mixes with jit default placement exactly like a
+    freshly ``Amp.init``-ed one (committing scalars to one device while
+    matrices live on a mesh makes jit refuse the mix)."""
+    from jax.sharding import NamedSharding
+
+    def place(v, t):
+        if isinstance(t, jax.Array) and isinstance(t.sharding, NamedSharding):
+            return jax.device_put(v, t.sharding)
+        return v
+    return jax.tree.map(place, values, template)

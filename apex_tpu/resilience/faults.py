@@ -1,0 +1,204 @@
+"""Seeded, composable fault injection for training-loop chaos tests.
+
+Every failure mode the resilience layer claims to survive gets an
+injectable analog, so the claims are regression-tested instead of
+asserted: non-finite gradients (the r02-era overflow storms), checkpoint
+corruption/truncation (preemption mid-write), simulated SIGTERM
+mid-step, a hung step (the r02 chip-lease wedge,
+``INCIDENT_r02_wedge.json``), and slow/flaky checkpoint IO.
+
+Faults are plain frozen dataclasses; an injector composes any number of
+them and is driven by the resilience loop's hooks (or by hand in a
+test)::
+
+    inj = FaultInjector([NaNStorm(step=4, duration=6),
+                         CorruptCheckpoint(step=9, kind="truncate")])
+    with inj:
+        result = run_resilient(step, state, batches, ..., injector=inj)
+    inj.events   # what fired, when — becomes incident evidence
+
+Gradient poisoning is applied to the *batch* (first float leaf gets a
+non-finite element), which drives non-finite values through the real
+backward pass — the same route real bad data takes, and exactly what the
+amp overflow machinery must absorb.  ``NaNStorm.duration`` counts
+*firings*, not steps: after a rewind the replayed steps see clean data
+(a transient storm, not a deterministic poison), which is what lets the
+loop converge after recovery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import time
+from typing import Any, List, Optional, Sequence, Tuple
+
+
+class SimulatedPreemption(RuntimeError):
+    """Raised by the injector where SIGTERM would land mid-step."""
+
+    def __init__(self, step: int):
+        super().__init__(f"simulated preemption (SIGTERM) at step {step}")
+        self.step = step
+
+
+@dataclasses.dataclass(frozen=True)
+class NaNStorm:
+    """Non-finite gradients: from ``step``, the batch is poisoned for the
+    next ``duration`` firings (``value``: inf by default — saturates in
+    bf16 too, where a quiet NaN would)."""
+    step: int
+    duration: int = 1
+    value: float = float("inf")
+
+
+@dataclasses.dataclass(frozen=True)
+class CorruptCheckpoint:
+    """Damage the first checkpoint committed at/after ``step``:
+    ``kind="truncate"`` (preemption mid-write) or ``"corrupt"`` (bit rot);
+    target leaf file picked by the injector's seeded RNG."""
+    step: int
+    kind: str = "truncate"
+
+
+@dataclasses.dataclass(frozen=True)
+class Preempt:
+    """Raise :class:`SimulatedPreemption` at the start of ``step``."""
+    step: int
+
+
+@dataclasses.dataclass(frozen=True)
+class HangStep:
+    """Host-level hang of ``seconds`` at the start of ``step`` — the
+    watchdog's prey.  (A truly wedged device call can't be interrupted
+    from Python; a host sleep exercises the same detection path.)"""
+    step: int
+    seconds: float = 5.0
+
+
+@dataclasses.dataclass(frozen=True)
+class FlakyIO:
+    """First ``fails`` IO calls of ``op`` raise ``OSError`` — exercises
+    the loop's retry-with-backoff."""
+    op: str = "save"
+    fails: int = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class SlowIO:
+    """Every IO call of ``op`` sleeps ``seconds`` first."""
+    op: str = "save"
+    seconds: float = 0.05
+
+
+class FaultInjector:
+    """Composes faults behind the hooks the resilience stack calls.
+
+    Hooks (all no-ops when the fault list doesn't match):
+
+    - :meth:`on_step_start` — may sleep (:class:`HangStep`) or raise
+      (:class:`Preempt`); call first thing in the step.
+    - :meth:`poison_batch`  — returns the (possibly poisoned) batch.
+    - :meth:`io_hook`       — pass as ``DurableCheckpointManager(io_hook=...)``.
+    - :meth:`on_commit`     — pass as ``DurableCheckpointManager(on_commit=...)``.
+
+    Usable directly as a context manager (enter/exit just guard against
+    reuse and close the event log)."""
+
+    def __init__(self, faults: Sequence[Any] = (), seed: int = 0):
+        self.faults = list(faults)
+        self.rng = random.Random(seed)
+        self.events: List[dict] = []
+        self._storm_left = {id(f): f.duration for f in self.faults
+                            if isinstance(f, NaNStorm)}
+        self._flaky_left = {id(f): f.fails for f in self.faults
+                            if isinstance(f, FlakyIO)}
+        self._fired_once: set = set()   # HangStep/Preempt/CorruptCheckpoint
+        self._active = False
+
+    def __enter__(self) -> "FaultInjector":
+        self._active = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._active = False
+
+    def _record(self, fault: str, **info: Any) -> None:
+        from apex_tpu.resilience.incidents import utc_now
+        self.events.append({"fault": fault, "utc": utc_now(), **info})
+
+    # -- hooks -----------------------------------------------------------
+    def on_step_start(self, step: int) -> None:
+        """Fire-once per fault instance: a rewound/restarted run replays
+        step indices, and a hang or preemption is an *event*, not a
+        property of the step number."""
+        for f in self.faults:
+            if id(f) in self._fired_once:
+                continue
+            if isinstance(f, HangStep) and f.step == step:
+                self._fired_once.add(id(f))
+                self._record("hang_step", step=step, seconds=f.seconds)
+                time.sleep(f.seconds)
+            elif isinstance(f, Preempt) and f.step == step:
+                self._fired_once.add(id(f))
+                self._record("preempt", step=step)
+                raise SimulatedPreemption(step)
+
+    def poison_batch(self, step: int, batch: Tuple[Any, ...]
+                     ) -> Tuple[Any, ...]:
+        import jax
+        import jax.numpy as jnp
+        for f in self.faults:
+            if not isinstance(f, NaNStorm) or step < f.step:
+                continue
+            if self._storm_left.get(id(f), 0) <= 0:
+                continue
+            self._storm_left[id(f)] -= 1
+            self._record("nan_storm", step=step, value=repr(f.value))
+            leaves, treedef = jax.tree.flatten(batch)
+            for i, leaf in enumerate(leaves):
+                arr = jnp.asarray(leaf)
+                if jnp.issubdtype(arr.dtype, jnp.inexact):
+                    flat = arr.reshape(-1)
+                    flat = flat.at[0].set(jnp.asarray(f.value, arr.dtype))
+                    leaves[i] = flat.reshape(arr.shape)
+                    break
+            return jax.tree.unflatten(treedef, leaves)
+        return batch
+
+    def io_hook(self, op: str) -> None:
+        for f in self.faults:
+            if isinstance(f, SlowIO) and f.op == op:
+                self._record("slow_io", op=op, seconds=f.seconds)
+                time.sleep(f.seconds)
+            elif isinstance(f, FlakyIO) and f.op == op \
+                    and self._flaky_left.get(id(f), 0) > 0:
+                self._flaky_left[id(f)] -= 1
+                self._record("flaky_io", op=op,
+                             remaining=self._flaky_left[id(f)])
+                raise OSError(f"injected flaky {op} IO")
+
+    def on_commit(self, step: int, path: str) -> None:
+        for f in self.faults:
+            if not isinstance(f, CorruptCheckpoint) or id(f) in \
+                    self._fired_once or step < f.step:
+                continue
+            self._fired_once.add(id(f))
+            leaf_files = sorted(n for n in os.listdir(path)
+                                if n.endswith(".npy"))
+            if not leaf_files:
+                continue
+            victim = os.path.join(path, self.rng.choice(leaf_files))
+            size = os.path.getsize(victim)
+            if f.kind == "truncate":
+                with open(victim, "r+b") as fh:
+                    fh.truncate(max(0, size // 2))
+            else:
+                with open(victim, "r+b") as fh:
+                    fh.seek(max(0, size // 2))
+                    chunk = fh.read(8)
+                    fh.seek(max(0, size // 2))
+                    fh.write(bytes(b ^ 0xFF for b in chunk))
+            self._record("corrupt_checkpoint", step=step, kind=f.kind,
+                         file=os.path.basename(victim))

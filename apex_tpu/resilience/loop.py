@@ -1,0 +1,382 @@
+"""Self-healing training loop: watchdog, IO retry, divergence rewind.
+
+The r02 incident (``INCIDENT_r02_wedge.json``) is the design brief: a
+hung device call wedged a session for 6+ hours with no watchdog, no
+incident artifact, and no resumable state.  :func:`run_resilient` wraps
+a jitted train step so that the failure modes a production run actually
+hits become *handled inputs*:
+
+- **step watchdog** — a monitor thread tracks wall-clock per step; a
+  step that neither dispatches nor resolves within the budget produces
+  an incident artifact (with the main thread's stack as evidence) and a
+  graceful :class:`WatchdogTimeout` instead of a silent wedge.  The
+  monitor can only interrupt Python-level waits (``interrupt_main``); a
+  truly wedged C call still gets its incident written within the budget
+  — the artifact, not the unstick, is the contract (r02's gap).
+- **IO retry** — checkpoint save/restore runs through
+  :func:`retry_io` (bounded attempts, exponential backoff), so a flaky
+  filesystem is absorbed instead of killing the run.
+- **divergence sentinel** — distinguishes amp's *normal* overflow-skip
+  (scale halves, training continues) from pathological states: ``K``
+  consecutive overflows with the loss scale pinned at its floor
+  (``metrics["pinned_at_floor"]``), or a non-finite loss that is NOT an
+  overflow skip.  Response: rewind to the last good checkpoint with a
+  re-initialized scaler; after ``max_rewinds`` rewinds, hard-fail with a
+  structured incident instead of looping forever.
+
+Normal-path cost: the loop adds **no host sync on the step path** — it
+dispatches steps back-to-back and resolves each step's metrics one step
+behind (``sentinel_lag``), by which point they are (on an accelerator)
+already computed; the watchdog is a sleeping daemon thread and the
+in-flight table is two dict ops per step.  Measured overhead on the CPU
+bench smoke is recorded by ``tools/chaos_run.py --overhead`` (< 2%; see
+``docs/source/checkpoint.rst``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from apex_tpu.resilience import incidents as incidents_lib
+from apex_tpu.resilience.faults import FaultInjector, SimulatedPreemption
+
+
+class WatchdogTimeout(RuntimeError):
+    """A step exceeded the wall-clock budget; an incident was recorded."""
+
+
+class DivergenceError(RuntimeError):
+    """Pathological state persisted past the rewind budget (or there was
+    nothing to rewind to); an incident was recorded."""
+
+
+def retry_io(fn: Callable[[], Any], retries: int = 3,
+             backoff_s: float = 0.05,
+             on_retry: Optional[Callable[[int, BaseException], None]] = None
+             ) -> Any:
+    """Run ``fn`` with bounded retries and exponential backoff on
+    ``OSError`` (the checkpoint-IO failure class; anything else is a bug
+    and propagates immediately)."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except OSError as e:
+            attempt += 1
+            if attempt > retries:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            time.sleep(backoff_s * (2.0 ** (attempt - 1)))
+
+
+@dataclasses.dataclass
+class ResilienceConfig:
+    watchdog_timeout_s: float = 300.0
+    watchdog_poll_s: float = 0.05
+    checkpoint_every: int = 0          # 0 = no checkpointing
+    io_retries: int = 3
+    io_backoff_s: float = 0.05
+    max_rewinds: int = 2
+    overflow_patience: int = 4         # K pinned-at-floor overflows
+    sentinel_lag: int = 1              # steps to lag metric resolution
+    incident_path: Optional[str] = None  # where watchdog/divergence artifacts go
+
+
+@dataclasses.dataclass
+class RunResult:
+    state: Any
+    steps_completed: int
+    losses: List[Tuple[int, float]]
+    rewinds: int
+    events: List[dict]
+    incidents: List[dict]
+
+
+def run_resilient(
+    step_fn: Callable,
+    state: Any,
+    batches: Union[Sequence[Any], Callable[[int], Any]],
+    num_steps: int,
+    amp_obj: Any = None,
+    manager: Any = None,
+    config: Optional[ResilienceConfig] = None,
+    injector: Optional[FaultInjector] = None,
+) -> RunResult:
+    """Drive ``step_fn(state, *batch) -> (state, metrics)`` for
+    ``num_steps`` with the protections in the module docstring.
+
+    ``batches`` is a sequence or a ``step -> batch`` callable (batch may
+    be a tuple of step-fn args or a single array).  ``amp_obj`` (the
+    bound :class:`~apex_tpu.amp.frontend.Amp`) enables scaler re-init on
+    rewind; ``manager`` (a
+    :class:`~apex_tpu.resilience.durable.DurableCheckpointManager`)
+    enables on-disk checkpointing and checksum-verified rewind — without
+    one, an in-memory host snapshot at the same cadence backs rewind.
+
+    On a :class:`~apex_tpu.resilience.faults.SimulatedPreemption` (or a
+    real ``KeyboardInterrupt`` that is not the watchdog), in-flight saves
+    are flushed and an incident recorded (status ``preempted`` /
+    ``interrupted``) before re-raising — the next process's
+    ``manager.restore`` lands on the last good snapshot.
+    """
+    cfg = config or ResilienceConfig()
+    from apex_tpu import checkpoint as ckpt
+    from apex_tpu.amp.scaler import all_finite
+
+    if callable(batches):
+        batch_fn = batches
+    else:
+        batch_fn = lambda i: batches[i]  # noqa: E731
+
+    events: List[dict] = []
+    written_incidents: List[dict] = []
+    losses: List[Tuple[int, float]] = []
+
+    # -- watchdog ---------------------------------------------------------
+    inflight: Dict[int, float] = {}
+    lock = threading.Lock()
+    abort = threading.Event()
+    stop = threading.Event()
+    # the thread driving this loop: its stack is the hang evidence, and
+    # interrupt_main only helps when it IS the main thread
+    entry_thread = threading.current_thread()
+
+    def _write_incident(status: str, summary: str,
+                        evidence: List[Any], **extra: Any) -> None:
+        try:
+            if cfg.incident_path:
+                rec = incidents_lib.write_incident(
+                    cfg.incident_path, status, summary, evidence, **extra)
+            else:
+                rec = incidents_lib.make_incident(status, summary, evidence,
+                                                  **extra)
+            written_incidents.append(rec)
+        except Exception:  # incident writing must never mask the failure
+            traceback.print_exc()
+
+    def _monitor() -> None:
+        while not stop.wait(cfg.watchdog_poll_s):
+            with lock:
+                if not inflight:
+                    continue
+                step_i, t0 = min(inflight.items(), key=lambda kv: kv[1])
+            elapsed = time.monotonic() - t0
+            if elapsed <= cfg.watchdog_timeout_s:
+                continue
+            frames = None
+            try:
+                import sys
+                frame = sys._current_frames().get(entry_thread.ident)
+                if frame is not None:
+                    frames = traceback.format_stack(frame)
+            except Exception:
+                pass
+            _write_incident(
+                "watchdog-timeout",
+                f"step {step_i} exceeded the {cfg.watchdog_timeout_s}s "
+                "wall-clock budget; aborting instead of wedging (r02 "
+                "mitigation)",
+                [f"step {step_i} in flight {elapsed:.3f}s > budget "
+                 f"{cfg.watchdog_timeout_s}s"]
+                + ([{"main_thread_stack": frames[-6:]}] if frames else []),
+            )
+            abort.set()
+            if entry_thread is threading.main_thread():
+                try:        # break a Python-level wait; a loop driven
+                    import _thread      # from a worker thread relies on
+                    _thread.interrupt_main()  # the abort flag instead
+                except Exception:
+                    pass
+            return
+
+    monitor = threading.Thread(target=_monitor, daemon=True,
+                               name="apex-tpu-watchdog")
+    monitor.start()
+
+    # -- rewind machinery -------------------------------------------------
+    rewinds = 0
+    consecutive_pinned = 0
+    mem_snapshot: Optional[Tuple[int, Any]] = None  # (step, host payload)
+
+    def _reinit_scaler(st: Any) -> Any:
+        if amp_obj is None or not hasattr(st, "scaler_states"):
+            return st
+        return st._replace(scaler_states=tuple(
+            amp_obj.scaler.init_state() for _ in st.scaler_states))
+
+    def _save(step_i: int, st: Any) -> None:
+        if not bool(all_finite(st.master_params
+                               if hasattr(st, "master_params") else st)):
+            events.append({"event": "checkpoint_skipped_nonfinite",
+                           "step": step_i})
+            return
+        nonlocal mem_snapshot
+        if manager is not None:
+            retry_io(lambda: manager.save(step_i, st),
+                     retries=cfg.io_retries, backoff_s=cfg.io_backoff_s,
+                     on_retry=lambda a, e: events.append(
+                         {"event": "save_retry", "step": step_i,
+                          "attempt": a, "error": repr(e)}))
+        else:   # managerless runs rewind from a host snapshot instead
+            mem_snapshot = (step_i, ckpt.state_dict(st))
+        events.append({"event": "checkpoint", "step": step_i})
+
+    def _rewind(st: Any, reason: str) -> Tuple[Any, int]:
+        nonlocal rewinds, consecutive_pinned
+        rewinds += 1
+        consecutive_pinned = 0
+        if rewinds > cfg.max_rewinds:
+            _write_incident(
+                "diverged",
+                f"pathological state persisted past max_rewinds="
+                f"{cfg.max_rewinds}: {reason}",
+                [reason] + events[-8:],
+                rewinds=rewinds - 1)
+            raise DivergenceError(
+                f"exceeded max_rewinds={cfg.max_rewinds}: {reason}")
+        restored = None
+        if manager is not None:
+            try:        # flush in-flight async saves before deciding
+                manager.wait()   # whether there is anything to rewind to
+            except RuntimeError as e:
+                events.append({"event": "rewind_flush_error",
+                               "error": repr(e)})
+        if manager is not None and manager.all_steps():
+            new_state, _ = retry_io(
+                lambda: manager.restore(st),
+                retries=cfg.io_retries, backoff_s=cfg.io_backoff_s)
+            restored = manager.last_restore["step"]
+        elif mem_snapshot is not None:
+            snap_step, payload = mem_snapshot
+            new_state, _ = ckpt.load_state_dict(st, payload)
+            restored = snap_step
+        else:
+            _write_incident(
+                "diverged", f"{reason} — and no checkpoint to rewind to",
+                [reason], rewinds=rewinds)
+            raise DivergenceError(f"{reason}; no checkpoint to rewind to")
+        new_state = _reinit_scaler(new_state)
+        events.append({"event": "rewind", "to_step": restored,
+                       "reason": reason, "rewind_count": rewinds})
+        return new_state, restored + 1
+
+    # -- main loop --------------------------------------------------------
+    pending: deque = deque()   # (step, metrics) awaiting resolution
+    i = 0
+    steps_completed = 0
+
+    def _resolve(entry: Tuple[int, dict], st: Any) -> Tuple[Any, Optional[int]]:
+        """Consume one lagged metrics record; returns (state, jump)."""
+        nonlocal consecutive_pinned, steps_completed
+        j, m = entry
+        # one host fetch for the three sentinel scalars (by now — one
+        # step behind dispatch — they are already computed, so this does
+        # not stall the device pipeline)
+        import jax
+        loss, overflow, pinned = jax.device_get(
+            (m["loss"], m.get("overflow", False),
+             m.get("pinned_at_floor", False)))
+        loss = float(np.asarray(loss))
+        # multi-loss metrics carry per-scaler tuples: any scaler counts
+        overflow = bool(np.any(np.asarray(overflow)))
+        pinned = bool(np.any(np.asarray(pinned)))
+        with lock:
+            inflight.pop(j, None)
+        losses.append((j, loss))
+        steps_completed = max(steps_completed, j + 1)
+        if overflow and pinned:
+            consecutive_pinned += 1
+        else:
+            consecutive_pinned = 0
+        if consecutive_pinned >= cfg.overflow_patience:
+            return _rewind(st, f"{consecutive_pinned} consecutive overflows "
+                               "with loss scale pinned at min_loss_scale")
+        if not math.isfinite(loss) and not overflow:
+            return _rewind(st, f"non-finite loss {loss} at step {j} outside "
+                               "an overflow skip")
+        return st, None
+
+    try:
+        try:
+            while i < num_steps or pending:
+                if abort.is_set():
+                    raise WatchdogTimeout(
+                        "watchdog aborted the run; see incident record")
+                if i < num_steps:
+                    batch = batch_fn(i)
+                    if not isinstance(batch, tuple):
+                        batch = (batch,)
+                    with lock:
+                        inflight[i] = time.monotonic()
+                    if injector is not None:
+                        injector.on_step_start(i)
+                        batch = injector.poison_batch(i, batch)
+                    state, metrics = step_fn(state, *batch)
+                    pending.append((i, metrics))
+                # resolve lagged metrics (all of them once dispatch is done)
+                lag = cfg.sentinel_lag if i < num_steps else 0
+                jump = None
+                while len(pending) > lag and jump is None:
+                    state, jump = _resolve(pending.popleft(), state)
+                if jump is not None:
+                    pending.clear()
+                    with lock:
+                        inflight.clear()
+                    i = jump
+                    continue
+                if i < num_steps and cfg.checkpoint_every \
+                        and (i + 1) % cfg.checkpoint_every == 0:
+                    _save(i, state)
+                i += 1
+        except KeyboardInterrupt:
+            if abort.is_set():
+                raise WatchdogTimeout(
+                    "watchdog aborted the run; see incident record") from None
+            raise
+    except (SimulatedPreemption, KeyboardInterrupt) as e:
+        if manager is not None:
+            try:
+                manager.wait()
+            except Exception:
+                pass
+        if isinstance(e, SimulatedPreemption):
+            _write_incident(
+                "preempted",
+                f"SIGTERM at step {e.step}; in-flight checkpoints flushed — "
+                "restart restores the last good snapshot",
+                [str(e)] + ([{"injector_events": injector.events[-6:]}]
+                            if injector else []))
+        else:   # a real operator interrupt still leaves an artifact
+            _write_incident(
+                "interrupted",
+                f"KeyboardInterrupt around step {i}; in-flight checkpoints "
+                "flushed — restart restores the last good snapshot",
+                [f"interrupted at step {i} of {num_steps}"])
+        raise
+    finally:
+        stop.set()
+        monitor.join(timeout=1.0)
+        if manager is not None:
+            try:
+                manager.wait()
+            except Exception as e:
+                # surface a tail async-save failure unless it would mask
+                # the exception already propagating
+                events.append({"event": "final_wait_error", "error": repr(e)})
+                import sys as _sys
+                if _sys.exc_info()[0] is None:
+                    raise
+
+    return RunResult(state=state, steps_completed=steps_completed,
+                     losses=losses, rewinds=rewinds, events=events,
+                     incidents=written_incidents)

@@ -265,7 +265,8 @@ def train_real(args, state, batch_stats, step, validate, mgr,
                                                        jnp.float32)})
             if is_best:
                 # the reference copies checkpoint.pth.tar -> model_best;
-                # orbax keeps whole step dirs, so record WHICH step is best
+                # the durable manager keeps whole step dirs, so record
+                # WHICH step is best
                 with open(os.path.join(args.checkpoint_dir,
                                        "best.json"), "w") as f:
                     json.dump({"step": (epoch + 1) * len_epoch - 1,

@@ -1,0 +1,227 @@
+"""Sharded checkpoint/resume with mesh-shape change — VERDICT item 3's
+"done" bar: save an FSDP-sharded :class:`AmpState` (masters, moments,
+scaler) on the 8-device virtual mesh, restore it exactly, restore it
+onto a *4-device* mesh, and continue training bit-consistently with the
+unsharded reference run.
+
+Why bitwise is attainable: the durable layer stores full gathered host
+arrays per leaf and places them onto the *template's* shardings on
+restore, so the restored values are the saved values, bit for bit, on
+any mesh.  And on this suite's virtual CPU mesh the sharded training
+step itself reproduces the unsharded step bitwise for these shapes
+(pinned by ``test_sharded_step_matches_unsharded_bitwise`` below — if
+an XLA change ever breaks that premise, THAT test names it, separating
+"sharded arithmetic drifted" from "the checkpoint layer broke").
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from apex_tpu import amp, checkpoint
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.resilience import DurableCheckpointManager
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8,
+    reason="needs 8 devices (virtual CPU mesh or a pod slice)")
+
+
+def _loss_fn(p, xb):
+    h = jax.nn.relu(xb @ p["w1"])
+    return jnp.mean(jnp.square(h @ p["w2"]))
+
+
+def _fresh():
+    params = {
+        "w1": jax.random.normal(jax.random.PRNGKey(3), (8, 32)),
+        "w2": jax.random.normal(jax.random.PRNGKey(4), (32, 8)),
+    }
+    a = amp.initialize(optimizer=FusedAdam(lr=1e-2), opt_level="O2",
+                       verbosity=0)
+    step = jax.jit(amp.make_train_step(a, _loss_fn))
+    x = jax.random.normal(jax.random.PRNGKey(5), (16, 8))
+    return a, step, params, x
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("data",))
+
+
+def _fsdp_put(state, mesh):
+    """ZeRO-3 layout: params AND moments shard over "data" (w1 on its
+    output dim, w2 on its input dim); scalars replicate."""
+    shardings = {"w1": NamedSharding(mesh, P(None, "data")),
+                 "w2": NamedSharding(mesh, P("data", None))}
+
+    def put(path, leaf):
+        key = jax.tree_util.keystr(path)
+        for name, s in shardings.items():
+            if name in key and getattr(leaf, "ndim", 0) == 2:
+                return jax.device_put(leaf, s)
+        return leaf
+    return jax.tree_util.tree_map_with_path(put, state)
+
+
+def _host(tree):
+    return jax.tree.map(np.asarray, tree)
+
+
+def _assert_states_equal(got, want, msg=""):
+    for (pa, la), (_pb, lb) in zip(
+            jax.tree_util.tree_leaves_with_path(got),
+            jax.tree_util.tree_leaves_with_path(want)):
+        np.testing.assert_array_equal(
+            np.asarray(la), np.asarray(lb),
+            err_msg=f"{msg}: {jax.tree_util.keystr(pa)}")
+
+
+def test_sharded_step_matches_unsharded_bitwise():
+    """Premise pin: on this platform the FSDP-sharded train step equals
+    the unsharded step bit-for-bit (exact-restore + reshape tests lean
+    on this to demand bitwise continuation)."""
+    a, step, params, x = _fresh()
+    mesh = _mesh(8)
+    st_sh = _fsdp_put(a.init(params), mesh)
+    x_sh = jax.device_put(x, NamedSharding(mesh, P("data")))
+    st_un = a.init(params)
+    for _ in range(3):
+        st_sh, m_sh = step(st_sh, x_sh)
+        st_un, m_un = step(st_un, x)
+    assert float(m_sh["loss"]) == float(m_un["loss"])
+    _assert_states_equal(_host(st_sh), _host(st_un), "sharded vs unsharded")
+
+
+def test_save_sharded_restore_exact_same_mesh(tmp_path):
+    """Save at step 3 on the 8-device mesh; restore onto the SAME mesh:
+    every leaf bitwise equal (scaler included), layouts preserved, and 3
+    more steps match the uninterrupted run bitwise."""
+    a, step, params, x = _fresh()
+    mesh = _mesh(8)
+    state = _fsdp_put(a.init(params), mesh)
+    x_sh = jax.device_put(x, NamedSharding(mesh, P("data")))
+    for _ in range(3):
+        state, _ = step(state, x_sh)
+
+    mgr = DurableCheckpointManager(str(tmp_path))
+    mgr.save(3, state)
+    mgr.close()
+    saved_host = _host(state)
+
+    cont = state
+    for _ in range(3):
+        cont, _ = step(cont, x_sh)
+
+    template = _fsdp_put(jax.tree.map(jnp.zeros_like, _host(state)), mesh)
+    restored, _ = mgr.restore(template)
+    _assert_states_equal(_host(restored), saved_host, "restored vs saved")
+    assert restored.master_params["w1"].sharding.spec == P(None, "data")
+    for _ in range(3):
+        restored, _ = step(restored, x_sh)
+    _assert_states_equal(_host(restored), _host(cont),
+                         "resumed vs uninterrupted")
+
+
+def test_restore_onto_smaller_mesh_bit_consistent_with_unsharded(tmp_path):
+    """The reshape bar: save FSDP-sharded on 8 devices, restore onto a
+    4-device mesh AND onto a single device; the restored leaves are
+    bitwise the saved ones, the 4-device layout is real (4 distinct
+    devices), and 3 further steps agree bitwise across 4-device,
+    8-device-uninterrupted, and the unsharded reference."""
+    a, step, params, x = _fresh()
+    mesh8 = _mesh(8)
+    state = _fsdp_put(a.init(params), mesh8)
+    x8 = jax.device_put(x, NamedSharding(mesh8, P("data")))
+    for _ in range(3):
+        state, _ = step(state, x8)
+    mgr = DurableCheckpointManager(str(tmp_path))
+    mgr.save(3, state)
+    mgr.wait()
+    saved_host = _host(state)
+
+    # uninterrupted 8-device continuation (the "what should have happened")
+    cont8 = state
+    for _ in range(3):
+        cont8, _ = step(cont8, x8)
+
+    # (a) restore onto the 4-device mesh and continue
+    mesh4 = _mesh(4)
+    template4 = _fsdp_put(a.init(params), mesh4)
+    restored4, _ = mgr.restore(template4)
+    _assert_states_equal(_host(restored4), saved_host, "4-dev vs saved")
+    w1 = restored4.master_params["w1"]
+    assert w1.sharding.spec == P(None, "data")
+    assert len(w1.sharding.device_set) == 4
+    x4 = jax.device_put(x, NamedSharding(mesh4, P("data")))
+    for _ in range(3):
+        restored4, _ = step(restored4, x4)
+
+    # (b) restore unsharded (single device) and continue — the reference
+    template1 = a.init(params)
+    restored1, _ = mgr.restore(template1)
+    _assert_states_equal(_host(restored1), saved_host, "unsharded vs saved")
+    for _ in range(3):
+        restored1, _ = step(restored1, x)
+
+    _assert_states_equal(_host(restored4), _host(restored1),
+                         "4-dev continuation vs unsharded reference")
+    _assert_states_equal(_host(cont8), _host(restored1),
+                         "8-dev uninterrupted vs unsharded reference")
+
+
+def test_pipeline_stage_stacked_leaves_reshape(tmp_path):
+    """Pipeline-style layout: stage-stacked leaves (leading stage axis,
+    ``stack_stage_params``) sharded ``P("pipe")`` over an 8-way pipe
+    mesh round-trip onto a 4-way pipe mesh (2 stages per device) with
+    bitwise-identical values — the other sharded-state family the
+    checkpoint layer must carry (VERDICT item 3 names FSDP *and*
+    pipeline)."""
+    a = amp.initialize(optimizer=FusedAdam(lr=1e-2), opt_level="O2",
+                       verbosity=0)
+    stages = {"stages": jax.random.normal(jax.random.PRNGKey(7), (8, 4, 4))}
+    mesh8 = Mesh(np.array(jax.devices()[:8]), ("pipe",))
+
+    def put(state, mesh):
+        sh = NamedSharding(mesh, P("pipe"))
+        return jax.tree.map(
+            lambda t: jax.device_put(t, sh) if getattr(t, "ndim", 0) == 3
+            else t, state)
+
+    state = put(a.init(stages), mesh8)
+    mgr = DurableCheckpointManager(str(tmp_path))
+    mgr.save(0, state)
+    mgr.wait()
+
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("pipe",))
+    template = put(a.init(stages), mesh4)
+    restored, _ = mgr.restore(template)
+    _assert_states_equal(_host(restored), _host(state), "pipe reshape")
+    got = restored.master_params["stages"]
+    assert got.sharding.spec == P("pipe")
+    assert len(got.sharding.device_set) == 4
+
+
+def test_restore_scaler_state_travels_with_reshape(tmp_path):
+    """The scaler (loss scale + unskipped) must survive the mesh change
+    too — it is exactly the state the reference lost on restart."""
+    a, step, params, x = _fresh()
+    mesh8 = _mesh(8)
+    state = _fsdp_put(a.init(params), mesh8)
+    x8 = jax.device_put(x, NamedSharding(mesh8, P("data")))
+    # drive an overflow so the scale moves off init
+    x_bad = x8.at[0, 0].set(jnp.inf)
+    state, m = step(state, x_bad)
+    assert bool(m["overflow"])
+    mgr = DurableCheckpointManager(str(tmp_path))
+    mgr.save(1, state)
+    mgr.wait()
+
+    restored, _ = mgr.restore(a.init(params))   # single-device template
+    assert float(restored.scaler_states[0].loss_scale) == \
+        float(state.scaler_states[0].loss_scale) == 32768.0
+    assert int(restored.scaler_states[0].unskipped) == \
+        int(state.scaler_states[0].unskipped)

@@ -36,6 +36,87 @@ WORKER = textwrap.dedent("""
 """)
 
 
+class _Boom(RuntimeError):
+    pass
+
+
+def test_initialize_retries_with_backoff_then_names_missing_ranks(monkeypatch):
+    """Bounded cluster init (ISSUE 3 satellite): a peer that never
+    arrives must surface as ClusterInitError naming the candidate
+    missing ranks after timeout x retries with backoff — not a hang."""
+    from apex_tpu.parallel import multiproc
+
+    calls = {"n": 0}
+    sleeps = []
+
+    def never_forms(coordinator_address=None, num_processes=None,
+                    process_id=None, initialization_timeout=None):
+        calls["n"] += 1
+        # bounded per-attempt: the timeout knob must be threaded through
+        # (initialize feature-detects it from this signature)
+        assert initialization_timeout == 1
+        raise _Boom("barrier timed out")
+
+    monkeypatch.setattr(jax_distributed(), "initialize", never_forms)
+    monkeypatch.setattr(multiproc.time, "sleep", sleeps.append)
+    with pytest.raises(multiproc.ClusterInitError) as ei:
+        multiproc.initialize(coordinator_address="localhost:1",
+                             num_processes=4, process_id=1,
+                             timeout_s=1.0, retries=2, backoff_s=0.5)
+    msg = str(ei.value)
+    assert "rank 1 of 4" in msg
+    assert "[0, 2, 3]" in msg            # the ranks that can be missing
+    assert "3 attempt(s)" in msg
+    assert calls["n"] == 3
+    assert sleeps == [0.5, 1.0]          # exponential backoff
+
+
+def test_initialize_env_tunable_and_succeeds_mid_retry(monkeypatch):
+    from apex_tpu.parallel import multiproc
+
+    calls = {"n": 0}
+
+    def flaky(**kwargs):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("peer not yet up")
+
+    monkeypatch.setattr(jax_distributed(), "initialize", flaky)
+    monkeypatch.setattr(multiproc.time, "sleep", lambda s: None)
+    monkeypatch.setenv("APEX_TPU_INIT_TIMEOUT_S", "7")
+    monkeypatch.setenv("APEX_TPU_INIT_RETRIES", "5")
+    monkeypatch.setenv("APEX_TPU_INIT_BACKOFF_S", "0.01")
+    multiproc.initialize(coordinator_address="localhost:1",
+                         num_processes=2, process_id=0)
+    assert calls["n"] == 3               # recovered on the third attempt
+
+
+def test_initialize_already_initialized_fails_fast(monkeypatch):
+    """A double-initialize is a programming error, not weather: no
+    retries, no backoff, no phantom missing-peer report."""
+    from apex_tpu.parallel import multiproc
+
+    calls = {"n": 0}
+
+    def double(**kwargs):
+        calls["n"] += 1
+        raise RuntimeError("jax.distributed is already initialized")
+
+    monkeypatch.setattr(jax_distributed(), "initialize", double)
+    monkeypatch.setattr(multiproc.time, "sleep",
+                        lambda s: pytest.fail("must not back off"))
+    with pytest.raises(RuntimeError, match="already initialized"):
+        multiproc.initialize(coordinator_address="localhost:1",
+                             num_processes=2, process_id=0,
+                             timeout_s=1.0, retries=5, backoff_s=9.0)
+    assert calls["n"] == 1
+
+
+def jax_distributed():
+    import jax
+    return jax.distributed
+
+
 @pytest.mark.skipif(os.environ.get("APEX_TPU_TEST_PLATFORM") not in (None, "cpu"),
                     reason="local spawner test runs on the CPU backend")
 def test_spawn_two_process_psum(tmp_path):

@@ -1,0 +1,55 @@
+"""tools/chaos_run.py smoke — tier-1 regression gate for the fault
+injector itself (ISSUE 3 satellite): CPU, tiny model, two faults
+(NaN-grad storm + checkpoint truncation), and the emitted artifact must
+satisfy the incident schema gate hygiene enforces."""
+
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO / "tools"))
+
+import chaos_run  # noqa: E402
+
+from apex_tpu.resilience import validate_incident  # noqa: E402
+
+
+def test_chaos_smoke_nan_storm_plus_truncation(tmp_path):
+    out = tmp_path / "INCIDENT_chaos_smoke.json"
+    rc = chaos_run.main([
+        "--steps", "18",
+        "--faults", "nan_storm@5", "ckpt_truncate@7",
+        "--checkpoint-every", "3",
+        "--ckpt-dir", str(tmp_path / "ckpt"),
+        "--out", str(out),
+    ])
+    assert rc == 0
+    rec = json.loads(out.read_text())
+    assert validate_incident(rec) == []
+    assert rec["status"] == "recovered"
+
+    flat = json.dumps(rec)
+    # both faults demonstrably fired...
+    assert "nan_storm" in flat and "corrupt_checkpoint" in flat
+    # ...and the loop rewound past the truncated snapshot
+    assert '"event": "rewind"' in flat or '"rewind"' in flat
+
+
+def test_parse_fault_specs():
+    from apex_tpu.resilience import (CorruptCheckpoint, FlakyIO, HangStep,
+                                     NaNStorm, Preempt, SlowIO)
+    assert chaos_run.parse_fault("nan_storm@5") == NaNStorm(step=5,
+                                                           duration=6)
+    assert chaos_run.parse_fault("nan_storm@5:9") == NaNStorm(step=5,
+                                                              duration=9)
+    assert chaos_run.parse_fault("ckpt_truncate@7") == CorruptCheckpoint(
+        step=7, kind="truncate")
+    assert chaos_run.parse_fault("ckpt_corrupt@7") == CorruptCheckpoint(
+        step=7, kind="corrupt")
+    assert chaos_run.parse_fault("preempt@3") == Preempt(step=3)
+    assert chaos_run.parse_fault("hang@2:0.5") == HangStep(step=2,
+                                                           seconds=0.5)
+    assert chaos_run.parse_fault("flaky_io:3") == FlakyIO(op="save", fails=3)
+    assert chaos_run.parse_fault("slow_io:0.2") == SlowIO(op="save",
+                                                          seconds=0.2)

@@ -79,6 +79,61 @@ def test_scaler_state_persists():
         int(state.scaler_states[0].unskipped)
 
 
+def test_load_state_dict_missing_leaf_names_first_path():
+    """ISSUE 3 satellite: a checkpoint missing a leaf must raise naming
+    the first diverging tree path, not a cryptic tree/zip error."""
+    a, step, x, y, params = _setup()
+    state = a.init(params)
+    d = checkpoint.state_dict(state)
+    del d["master_params"]["AmpDense_0"]["bias"]
+    template = jax.tree.map(jnp.zeros_like, state)
+    with pytest.raises(ValueError) as ei:
+        checkpoint.load_state_dict(template, d)
+    msg = str(ei.value)
+    assert "structural mismatch" in msg
+    assert "AmpDense_0" in msg and "bias" in msg
+    assert "missing from checkpoint" in msg
+
+
+def test_load_state_dict_extra_leaf_names_first_path():
+    a, step, x, y, params = _setup()
+    state = a.init(params)
+    d = checkpoint.state_dict(state)
+    d["opt_state"] = dict(d["opt_state"]) if isinstance(d["opt_state"], dict) \
+        else d["opt_state"]
+    d["master_params"]["bogus_layer"] = {"kernel": np.zeros((2, 2))}
+    template = jax.tree.map(jnp.zeros_like, state)
+    with pytest.raises(ValueError) as ei:
+        checkpoint.load_state_dict(template, d)
+    msg = str(ei.value)
+    assert "bogus_layer" in msg and "not in template" in msg
+
+
+def test_load_state_dict_scaler_count_mismatch_is_structural():
+    a, step, x, y, params = _setup()
+    state = a.init(params)
+    d = checkpoint.state_dict(state)
+    d["scaler_states"] = d["scaler_states"] + d["scaler_states"]
+    template = jax.tree.map(jnp.zeros_like, state)
+    with pytest.raises(ValueError, match="structural mismatch"):
+        checkpoint.load_state_dict(template, d)
+
+
+def test_manager_restore_structural_mismatch_raises_not_falls_back(tmp_path):
+    """A VALID snapshot + wrong template is a user error: restore must
+    raise the structural message, not silently fall back to an older
+    snapshot as if the newest were corrupt."""
+    a, step, x, y, params = _setup()
+    state = a.init(params)
+    mgr = checkpoint.CheckpointManager(str(tmp_path))
+    mgr.save(0, state, extras={"epoch": np.int32(3)})
+    mgr.wait()
+    template = jax.tree.map(jnp.zeros_like, state)
+    with pytest.raises(ValueError) as ei:
+        mgr.restore(template)          # saved WITH extras, template without
+    assert "epoch" in str(ei.value)
+
+
 def test_checkpoint_manager_retention(tmp_path):
     a, step, x, y, params = _setup()
     state = a.init(params)

@@ -7,6 +7,7 @@ green tier-1.  The unit tests pin the verdict classes on throwaway git
 repos.
 """
 
+import json
 import subprocess
 import sys
 from pathlib import Path
@@ -86,3 +87,65 @@ def test_non_gate_files_ignored(tmp_repo):
     (tmp_repo / "scratch.json").write_text("{}")
     (tmp_repo / "KERNELBENCH.json").write_text("{}")  # un-numbered out
     assert gate_hygiene.check(str(tmp_repo))["ok"]
+
+
+def _incidents_module(repo):
+    """The schema validator the tmp repo's check will load — copy the
+    real one in, like a real checkout has."""
+    src = REPO / "apex_tpu" / "resilience" / "incidents.py"
+    dst = repo / "apex_tpu" / "resilience"
+    dst.mkdir(parents=True, exist_ok=True)
+    (dst / "incidents.py").write_text(src.read_text())
+
+
+def test_committed_incident_validated_against_schema(tmp_repo):
+    """ISSUE 3 satellite: a committed INCIDENT_r*.json that does not
+    validate (here: no evidence list, no timestamp) fails hygiene."""
+    _incidents_module(tmp_repo)
+    (tmp_repo / "INCIDENT_r07_bad.json").write_text(
+        '{"status": "partial"}')
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "bad incident")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("INCIDENT_r07_bad.json" in p
+               for p in verdict["invalid_incidents"])
+    assert gate_hygiene.main(["--repo", str(tmp_repo)]) == 1
+
+
+def test_valid_incident_passes_schema(tmp_repo):
+    _incidents_module(tmp_repo)
+    (tmp_repo / "INCIDENT_r07_ok.json").write_text(json.dumps({
+        "status": "recovered", "utc": "2026-08-03T00:00:00Z",
+        "summary": "chaos run", "evidence": ["rewound at step 8"]}))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "good incident")
+    assert gate_hygiene.check(str(tmp_repo))["ok"]
+
+
+def test_uncommitted_incident_artifact_fails(tmp_repo):
+    """A fresh INCIDENT_rN.json is round evidence the moment it exists —
+    parked-but-untracked must fail like the KERNELBENCH artifacts do."""
+    _incidents_module(tmp_repo)
+    (tmp_repo / "INCIDENT_r08_new.json").write_text(json.dumps({
+        "status": "recovered", "utc": "2026-08-03T00:00:00Z",
+        "evidence": ["x"]}))
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert verdict["untracked"] == ["INCIDENT_r08_new.json"]
+
+
+def test_truncated_incident_json_is_invalid(tmp_repo):
+    _incidents_module(tmp_repo)
+    (tmp_repo / "INCIDENT_r09_trunc.json").write_text('{"status": "par')
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "truncated")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert any("unreadable incident JSON" in p
+               for p in verdict["invalid_incidents"])
+
+
+def test_repo_r02_incident_validates():
+    """The pre-existing wedge record is the schema's reference instance;
+    it must stay valid."""
+    assert gate_hygiene._validate_incidents(str(REPO)) == []

@@ -55,6 +55,51 @@ def test_min_scale_floor():
     assert float(st.loss_scale) == 2.0 ** 15
 
 
+def test_overflow_storm_respects_floor_and_pinned_flag_flips_exactly():
+    """ISSUE 3 satellite: an overflow storm must never push the scale
+    below min_loss_scale, and pinned_at_floor must flip exactly when the
+    scale REACHES the floor — one step earlier it is still False."""
+    s = LossScaler(min_loss_scale=2.0 ** 10)
+    st = s.init_state()
+    assert not bool(s.pinned_at_floor(st))
+    for k in range(1, 21):                   # 20-step storm
+        st, skip = s.update(st, jnp.asarray(False))
+        assert bool(skip)
+        expected = max(2.0 ** (16 - k), 2.0 ** 10)
+        assert float(st.loss_scale) == expected
+        assert float(st.loss_scale) >= 2.0 ** 10
+        # floor reached after exactly 6 halvings: 2^16 -> 2^10
+        assert bool(s.pinned_at_floor(st)) == (k >= 6)
+
+
+def test_default_floor_is_one():
+    s = LossScaler()     # min_loss_scale=None -> update clamps at 1.0
+    assert s.floor == 1.0
+    st = s.init_state()
+    for _ in range(40):
+        st, _ = s.update(st, jnp.asarray(False))
+    assert float(st.loss_scale) == 1.0
+    assert bool(s.pinned_at_floor(st))
+
+
+def test_pinned_flag_clears_when_scale_grows_off_floor():
+    s = LossScaler(min_loss_scale=2.0 ** 15, scale_window=2)
+    st = s.init_state()
+    st, _ = s.update(st, jnp.asarray(False))         # 2^16 -> 2^15: pinned
+    assert bool(s.pinned_at_floor(st))
+    for _ in range(2):                                # clean window: doubles
+        st, _ = s.update(st, jnp.asarray(True))
+    assert float(st.loss_scale) == 2.0 ** 16
+    assert not bool(s.pinned_at_floor(st))
+
+
+def test_static_scale_never_pinned():
+    s = LossScaler(loss_scale=128.0)
+    st = s.init_state()
+    st, _ = s.update(st, jnp.asarray(False))
+    assert not bool(s.pinned_at_floor(st))
+
+
 def test_static_scale_never_moves_but_skips():
     s = LossScaler(loss_scale=128.0)
     st = s.init_state()

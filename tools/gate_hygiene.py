@@ -12,7 +12,11 @@ This check fails (exit 1) when
 - ANY gate-baseline artifact (required or optional, e.g. the
   round-numbered ``KERNELBENCH_r*.json`` kernel-gate artifacts or
   ``BENCH_VARIANCE.json``) is modified, staged-but-uncommitted, or —
-  for round-numbered artifacts — present but never added.
+  for round-numbered artifacts — present but never added, or
+- a committed ``INCIDENT_r*.json`` does not validate against the
+  incident schema (``apex_tpu/resilience/incidents.py``: status, utc or
+  date, non-empty evidence) — chaos-run artifacts must not rot into
+  prose nobody can machine-check.
 
 It is wired into tier-1 (``tests/l0/test_gate_hygiene.py``), so a round
 cannot go green with dirty gate memory.  Best-effort on the VCS side:
@@ -39,10 +43,33 @@ REQUIRED = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json")
 
 #: All gate-baseline patterns whose working-tree copies must match HEAD
 #: (round-numbered artifacts included: a fresh KERNELBENCH_rN.json is
-#: gate memory the moment it exists).
+#: gate memory the moment it exists; incident records are round
+#: evidence the same way).
 PATTERNS = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json",
             "BENCH_VARIANCE.json", "KERNELBENCH_r*.json",
-            "BENCH_r*.json")
+            "BENCH_r*.json", "INCIDENT_r*.json")
+
+#: Round-numbered incident artifacts additionally get schema-validated.
+INCIDENT_PATTERN = "INCIDENT_r*.json"
+
+
+def _validate_incidents(repo: str) -> "list[str]":
+    """Schema problems over every present INCIDENT_r*.json, as
+    ``path: problem`` strings.  Loads the stdlib-only schema module
+    directly by file path so this tool never imports jax."""
+    import importlib.util
+    mod_path = Path(repo) / "apex_tpu" / "resilience" / "incidents.py"
+    if not mod_path.exists():  # best-effort outside a full checkout
+        return []
+    spec = importlib.util.spec_from_file_location("_apex_incidents",
+                                                  mod_path)
+    incidents = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(incidents)
+    problems = []
+    for p in sorted(Path(repo).glob(INCIDENT_PATTERN)):
+        for msg in incidents.validate_incident_file(str(p)):
+            problems.append(f"{p.name}: {msg}")
+    return problems
 
 
 def _git(repo: str, *args: str) -> "str | None":
@@ -61,12 +88,13 @@ def _git(repo: str, *args: str) -> "str | None":
 
 def check(repo: str = str(REPO)) -> dict:
     """``{"ok": bool, "missing": [...], "untracked": [...],
-    "dirty": [...]}`` — see the module docstring for the rules."""
+    "dirty": [...], "invalid_incidents": [...]}`` — see the module
+    docstring for the rules."""
     tracked_raw = _git(repo, "ls-files", "--", *PATTERNS)
     if tracked_raw is None:
         return {"ok": True, "skipped": "not a git checkout (or no git): "
                                        "hygiene unverifiable", "missing": [],
-                "untracked": [], "dirty": []}
+                "untracked": [], "dirty": [], "invalid_incidents": []}
     tracked = set(tracked_raw.split())
     missing = [f for f in REQUIRED
                if not (Path(repo) / f).exists() or f not in tracked]
@@ -86,8 +114,10 @@ def check(repo: str = str(REPO)) -> dict:
             untracked.append(path)
         else:
             dirty.append(path)
-    return {"ok": not (missing or untracked or dirty), "missing": missing,
-            "untracked": untracked, "dirty": dirty}
+    invalid = _validate_incidents(repo)
+    return {"ok": not (missing or untracked or dirty or invalid),
+            "missing": missing, "untracked": untracked, "dirty": dirty,
+            "invalid_incidents": invalid}
 
 
 def main(argv=None) -> int:
@@ -99,7 +129,8 @@ def main(argv=None) -> int:
     if not verdict["ok"]:
         print("gate_hygiene: gate-baseline artifacts must be committed — "
               f"missing/untracked {verdict['missing'] + verdict['untracked']},"
-              f" modified {verdict['dirty']}", file=sys.stderr)
+              f" modified {verdict['dirty']}; invalid incident records "
+              f"{verdict.get('invalid_incidents', [])}", file=sys.stderr)
         return 1
     return 0
 
