@@ -33,28 +33,38 @@ from apex_tpu.analysis.core import (
     DEFAULT_PASSES,
     PASSES,
     ArgInfo,
+    OutInfo,
     PassContext,
     analyze,
     analyze_lowered,
+    build_context,
+    lower_quiet,
     register_pass,
     run_passes,
 )
 from apex_tpu.analysis.report import SEVERITIES, Finding, Report
 
 # importing a pass module registers its pass; the import order here is
-# the DEFAULT_PASSES execution order plus the opt-in policy pass
+# the DEFAULT_PASSES execution order plus the opt-in passes (policy on
+# forwards; memory/cost/syncs need — or prefer — the compiled
+# executable, so the lane drivers request them explicitly)
 from apex_tpu.analysis import donation     # noqa: F401  (registers)
 from apex_tpu.analysis import sharding     # noqa: F401  (registers)
 from apex_tpu.analysis import collectives  # noqa: F401  (registers)
 from apex_tpu.analysis import constants    # noqa: F401  (registers)
 from apex_tpu.analysis import policy       # noqa: F401  (registers)
+from apex_tpu.analysis import memory       # noqa: F401  (registers)
+from apex_tpu.analysis import cost         # noqa: F401  (registers)
+from apex_tpu.analysis import syncs       # noqa: F401  (registers)
 
 from apex_tpu.analysis.collectives import collective_audit, collective_table
 
 __all__ = [
-    "analyze", "analyze_lowered", "run_passes", "register_pass",
-    "ArgInfo", "PassContext", "Finding", "Report",
+    "analyze", "analyze_lowered", "build_context", "lower_quiet",
+    "run_passes", "register_pass",
+    "ArgInfo", "OutInfo", "PassContext", "Finding", "Report",
     "PASSES", "DEFAULT_PASSES", "SEVERITIES",
     "collective_audit", "collective_table",
     "donation", "sharding", "collectives", "constants", "policy",
+    "memory", "cost", "syncs",
 ]

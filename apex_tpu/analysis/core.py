@@ -20,6 +20,15 @@ pass                 catches
                      of passed as arguments (recompile / bloat hazard)
 ``policy``           FP32-list-category work executing in 16-bit
                      (:mod:`apex_tpu.analysis.policy`, the O1 audit)
+``memory``           per-device peak HBM of the compiled step vs a
+                     device budget; donation-aliasing table; largest
+                     live buffers (:mod:`apex_tpu.analysis.memory`)
+``cost``             XLA cost-model flops / HBM traffic and the static
+                     roofline expectation they imply
+                     (:mod:`apex_tpu.analysis.cost`)
+``syncs``            host callbacks / infeed / outfeed on the step
+                     path, retrace hazards, in-place buffers read
+                     after dispatch (:mod:`apex_tpu.analysis.syncs`)
 ===================  ====================================================
 
 :func:`analyze` lowers (and by default compiles) a jittable function on
@@ -29,6 +38,14 @@ up in :data:`PASSES`.  ``DEFAULT_PASSES`` is the four whole-program
 graph passes; ``policy`` is opt-in because it must run on the FORWARD
 function, not the AD-generated train step (see
 ``apex_tpu/analysis/policy.py``).
+
+The program is lowered EXACTLY ONCE per :func:`analyze` call and the
+lowered object (plus the compiled executable, when ``compile=True``)
+is shared through the :class:`PassContext` — a mixed pass list such as
+``(*DEFAULT_PASSES, "memory", "policy")`` costs one lowering and at
+most one compilation; lowering-only passes read
+``ctx.stablehlo_text`` and never trigger a second lowering (the old
+two-``analyze()``-call idiom paid that twice).
 """
 
 from __future__ import annotations
@@ -60,6 +77,20 @@ class ArgInfo:
     nbytes: int
     donated: bool
     kept: bool = True
+    #: the aval's weak-type bit (True when the value was traced from a
+    #: Python literal); ``None`` when the jax version didn't expose it.
+    weak_type: Optional[bool] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class OutInfo:
+    """One flattened output of the analyzed program."""
+
+    index: int
+    path: str
+    shape: Tuple[int, ...]
+    dtype: str
+    nbytes: int
 
 
 @dataclasses.dataclass(frozen=True)
@@ -70,11 +101,30 @@ class PassContext:
     compiled (``analyze(..., compile=False)``); passes that need the
     compiled program degrade to lowering-time evidence or report an
     ``info`` finding saying they were skipped.
+
+    ``compiled`` carries the executable itself (``jax.stages.Compiled``)
+    whenever the program was compiled: the memory/cost passes read
+    XLA's own ``memory_analysis()`` / ``cost_analysis()`` from it —
+    numbers the HLO text alone doesn't give.  ``static_scalars``
+    records example arguments that jit bound STATICALLY at trace time
+    (they vanish from ``args``); the syncs pass turns numeric ones into
+    retrace-hazard findings.
     """
 
     stablehlo_text: str
     hlo_text: Optional[str] = None
     args: Tuple[ArgInfo, ...] = ()
+    outputs: Tuple[OutInfo, ...] = ()
+    compiled: Optional[Any] = None
+    #: ``(position_label, type_name, repr)`` of statically-bound
+    #: example args (positional index like ``"arg2"`` or the kwarg name)
+    static_scalars: Tuple[Tuple[str, str, str], ...] = ()
+    #: derived-table memo (alias set, kept-index map, donation table)
+    #: shared across passes — every derived table is a pure function of
+    #: one lowering's text, so it is parsed once per context, not once
+    #: per consuming pass (see :meth:`memo`)
+    _memo: Dict[str, Any] = dataclasses.field(
+        default_factory=dict, repr=False, compare=False)
 
     @property
     def kept_args(self) -> Tuple[ArgInfo, ...]:
@@ -82,6 +132,14 @@ class PassContext:
         k-th entry corresponds to ``%argk`` in the lowered ``main``
         signature and ``parameter(k)`` in the compiled entry."""
         return tuple(a for a in self.args if a.kept)
+
+    def memo(self, key: str, compute: Callable[[], Any]) -> Any:
+        """``compute()`` once per context under ``key`` (``None``
+        results are cached too — "numbering ambiguous" is as stable a
+        fact of a lowering as the table itself)."""
+        if key not in self._memo:
+            self._memo[key] = compute()
+        return self._memo[key]
 
 
 #: registry: pass name -> ``fn(ctx, **options) -> [Finding]``.  Pass
@@ -116,15 +174,94 @@ def _args_info(lowered) -> Tuple[ArgInfo, ...]:
         kept_idx = lowered._lowering.compile_args["kept_var_idx"]
     except (AttributeError, KeyError, TypeError):
         kept_idx = None
+    try:  # KEPT-arg avals, in text order — the weak-type bits live here
+        in_avals = tuple(lowered._lowering.compile_args["global_in_avals"])
+    except (AttributeError, KeyError, TypeError):
+        in_avals = None
     out = []
+    kept_seen = 0
     for i, (path, a) in enumerate(flat):
+        kept = True if kept_idx is None else i in kept_idx
+        weak: Optional[bool] = None
+        if kept and in_avals is not None and kept_seen < len(in_avals):
+            weak = bool(getattr(in_avals[kept_seen], "weak_type", False))
+        if kept:
+            kept_seen += 1
         out.append(ArgInfo(
             index=i, path=jax.tree_util.keystr(path),
             shape=tuple(a.shape), dtype=str(a.dtype),
             nbytes=_leaf_nbytes(a.shape, a.dtype),
             donated=bool(getattr(a, "donated", False)),
-            kept=True if kept_idx is None else i in kept_idx))
+            kept=kept, weak_type=weak))
     return tuple(out)
+
+
+def _out_info(lowered) -> Tuple[OutInfo, ...]:
+    try:
+        flat, _ = jax.tree_util.tree_flatten_with_path(lowered.out_info)
+    except (AttributeError, TypeError):
+        return ()
+    out = []
+    for i, (path, o) in enumerate(flat):
+        try:
+            out.append(OutInfo(
+                index=i, path=jax.tree_util.keystr(path),
+                shape=tuple(o.shape), dtype=str(o.dtype),
+                nbytes=_leaf_nbytes(o.shape, o.dtype)))
+        except (AttributeError, TypeError):
+            continue
+    return tuple(out)
+
+
+def _static_scalars(example_args, example_kwargs,
+                    args_info) -> Tuple[Tuple[str, str, str], ...]:
+    """Example args jit bound statically (they are absent from
+    ``args_info``, whose top level mirrors ``(args, kwargs)`` with
+    static entries REMOVED).  Position attribution is only sound when
+    the split is unambiguous, so this records suspects conservatively:
+    nothing unless fewer dynamic slots exist than example args, and
+    then only the hashable Python-numeric candidates (arrays can never
+    be static)."""
+    try:
+        dyn_pos, dyn_kw = args_info
+        n_static_pos = len(example_args) - len(dyn_pos)
+        static_kw = set(example_kwargs) - set(dyn_kw)
+    except (TypeError, ValueError):
+        return ()
+    suspects = []
+    if n_static_pos > 0:
+        def is_array(v):
+            return hasattr(v, "shape") and hasattr(v, "dtype")
+
+        numeric = [(f"arg{i}", type(v).__name__, repr(v)[:40])
+                   for i, v in enumerate(example_args)
+                   if isinstance(v, (int, float))
+                   and not isinstance(v, bool)]
+        # static-able candidates: anything that isn't an array (arrays
+        # are always dynamic).  The exact attribution is only sound
+        # when the numerics are the ONLY candidates and their count
+        # matches the static count — a non-numeric candidate (a mode
+        # string, a config object) could be the real static, leaving
+        # the numeric one dynamic.
+        n_nonarray = sum(1 for v in example_args if not is_array(v))
+        if numeric and len(numeric) == n_static_pos \
+                and n_nonarray == n_static_pos:
+            suspects.extend(numeric)
+        elif numeric:
+            # which example arg was static isn't recoverable from the
+            # traced signature — report the candidate set rather than
+            # guess (a wrong name would tell the user to fix the
+            # already-dynamic argument)
+            cands = ", ".join(f"{lbl}={val}"
+                              for lbl, _, val in numeric)
+            suspects.append(("ambiguous", "int/float",
+                             f"{n_static_pos} static slot(s); numeric "
+                             f"candidates: {cands}"))
+    for k in sorted(static_kw):
+        v = example_kwargs[k]
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            suspects.append((k, type(v).__name__, repr(v)[:40]))
+    return tuple(suspects)
 
 
 def run_passes(ctx: PassContext,
@@ -146,14 +283,38 @@ def run_passes(ctx: PassContext,
     return make_report(findings, names)
 
 
+def build_context(lowered, compile: bool = True,
+                  static_scalars=()) -> PassContext:
+    """One :class:`PassContext` from one lowering: the lowered text,
+    the arg/output tables, and (when ``compile``) the compiled
+    executable plus its HLO text — shared by every pass so a mixed
+    pass list never lowers or compiles twice."""
+    compiled = lowered.compile() if compile else None
+    return PassContext(
+        stablehlo_text=lowered.as_text(),
+        hlo_text=compiled.as_text() if compiled is not None else None,
+        args=_args_info(lowered), outputs=_out_info(lowered),
+        compiled=compiled, static_scalars=tuple(static_scalars))
+
+
+def lower_quiet(jitted, *args, **kwargs):
+    """Lower with JAX's lowering-time "Some donated buffers were not
+    usable" warning suppressed: turning that warning into a
+    structured, gateable finding is the donation pass's job — shared
+    by :func:`analyze` and the lane drivers (``tools/graph_lint.py``)
+    so the suppression policy cannot drift between them."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        return jitted.lower(*args, **kwargs)
+
+
 def analyze_lowered(lowered,
                     passes: Optional[Sequence[str]] = None,
                     compile: bool = True,
                     options: Optional[Mapping] = None) -> Report:
     """Run lint passes over an already-``.lower()``-ed program."""
-    hlo_text = lowered.compile().as_text() if compile else None
-    ctx = PassContext(stablehlo_text=lowered.as_text(),
-                      hlo_text=hlo_text, args=_args_info(lowered))
+    ctx = build_context(lowered, compile=compile)
     return run_passes(ctx, passes=passes, options=options)
 
 
@@ -170,15 +331,21 @@ def analyze(fn: Callable, *args,
     exactly what the donation pass exists to check).  Otherwise it is
     jitted here with ``donate_argnums``.
 
+    The program is lowered once and (when ``compile=True``) compiled
+    once; every requested pass — compiled-evidence passes and
+    lowering-only passes alike — shares the resulting
+    :class:`PassContext`.  Prefer one ``analyze`` call with the full
+    pass list over stacked calls: each ``analyze`` pays its own
+    lowering.
+
     JAX's lowering-time "Some donated buffers were not usable" warning
     is suppressed: turning that warning into a structured, gateable
     finding is the donation pass's job.
     """
     jitted = fn if hasattr(fn, "lower") else \
         jax.jit(fn, donate_argnums=donate_argnums)
-    with warnings.catch_warnings():
-        warnings.filterwarnings(
-            "ignore", message="Some donated buffers were not usable")
-        lowered = jitted.lower(*args, **kwargs)
-    return analyze_lowered(lowered, passes=passes, compile=compile,
-                           options=options)
+    lowered = lower_quiet(jitted, *args, **kwargs)
+    ctx = build_context(
+        lowered, compile=compile,
+        static_scalars=_static_scalars(args, kwargs, lowered.args_info))
+    return run_passes(ctx, passes=passes, options=options)

@@ -89,6 +89,32 @@ def donor_args_stablehlo(stablehlo_text: str) -> Set[int]:
             if "jax.buffer_donor" in attrs}
 
 
+def kept_index_map(ctx: PassContext) -> "dict | None":
+    """``{flat arg index -> kept text/parameter position}`` when the
+    lowered signature confirms the kept-arg inference, ``None`` when
+    the numbering is ambiguous (the kept set comes from a private jax
+    attribute; a shifted numbering would let any alias-table consumer
+    report honored donations as dropped — every consumer must refuse
+    to guess, exactly as this pass does).  Memoized on the context:
+    the donation, memory, and syncs passes plus the graph_lint lane
+    record all consume it from one lowering."""
+    def compute():
+        kept = ctx.kept_args
+        sig_args = _main_arg_attrs(ctx.stablehlo_text)
+        if sig_args and len(sig_args) != len(kept):
+            return None
+        return {a.index: k for k, a in enumerate(kept)}
+    return ctx.memo("kept_index_map", compute)
+
+
+def aliased_parameter_set(ctx: PassContext) -> Set[int]:
+    """:func:`aliased_parameters` of the context's compiled HLO,
+    memoized — the alias blob is scanned once per lowering however
+    many passes read it."""
+    return ctx.memo("aliased_parameters",
+                    lambda: aliased_parameters(ctx.hlo_text))
+
+
 def donation_pass(ctx: PassContext, min_bytes: int = 0) -> List[Finding]:
     """Flag donated arguments that produced no input-output alias.
 
@@ -104,7 +130,7 @@ def donation_pass(ctx: PassContext, min_bytes: int = 0) -> List[Finding]:
         # every donated arg is dropped — falling back to lowering-time
         # markers here would downgrade dropped sharded donations
         # (jax.buffer_donor) to inconclusive
-        aliased = aliased_parameters(ctx.hlo_text)
+        aliased = aliased_parameter_set(ctx)
         unresolved: Set[int] = set()
         evidence = "compiled executable input_output_alias"
     else:
@@ -120,9 +146,10 @@ def donation_pass(ctx: PassContext, min_bytes: int = 0) -> List[Finding]:
     # check it against the lowered signature's actual arg count and
     # refuse to guess on mismatch — a shifted numbering would report
     # honored donations as dropped (same guard as sharding's index_ok).
-    kept = ctx.kept_args
-    sig_args = _main_arg_attrs(ctx.stablehlo_text)
-    if sig_args and len(sig_args) != len(kept):
+    kept_pos = kept_index_map(ctx)
+    if kept_pos is None:
+        kept = ctx.kept_args
+        sig_args = _main_arg_attrs(ctx.stablehlo_text)
         return [Finding(
             "donation", "info",
             f"cannot verify {len(donated)} donation(s): the lowered "
@@ -130,7 +157,6 @@ def donation_pass(ctx: PassContext, min_bytes: int = 0) -> List[Finding]:
             f"{len(kept)} were inferred kept — argument numbering is "
             f"ambiguous on this jax version",
             count=len(donated))]
-    kept_pos = {a.index: k for k, a in enumerate(kept)}
     findings: List[Finding] = []
     dropped_bytes = 0
     for a in donated:

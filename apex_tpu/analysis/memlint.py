@@ -1,0 +1,112 @@
+"""MEMLINT_r*.json — schema for the committed memory-lint artifact.
+
+``tools/graph_lint.py --emit-json`` writes one of these per round: the
+static memory/cost story of every lint lane (per-lane peak HBM bytes,
+the donation-aliasing table, cost-model flops/bytes) plus the
+multichip dryrun slices' per-device HBM.  Like the incident records,
+the artifact is gate memory: ``tools/gate_hygiene.py`` validates every
+committed ``MEMLINT_r*.json`` against this schema so the numbers can't
+rot into prose nobody machine-checks.
+
+This module is deliberately **stdlib-only** (no jax import):
+``gate_hygiene`` loads it directly by file path the same way it loads
+``resilience/incidents.py``.
+
+Document shape::
+
+    {
+      "round": 1,
+      "platform": "cpu",               # backend the lint compiled for
+      "budget_bytes": 17179869184,     # device budget the lanes were
+                                       # gated against (null = ungated)
+      "lanes": {
+        "<lane>": {
+          "ok": true,                  # no error-severity finding
+          "peak_hbm_bytes": 123456,    # per-device static high-water
+          "breakdown": {"argument_bytes": ..., "output_bytes": ...,
+                        "temp_bytes": ..., "alias_bytes": ...},
+          "donation": [{"arg": "...", "bytes": 1, "aliased": true}],
+          "cost": {"flops": 1.0, "hbm_bytes": 2.0},
+          "findings": {"error": 0, "warning": 0, "info": 5}
+        }, ...
+      },
+      "multichip": {                   # optional: dryrun slice summary
+        "n_devices": 8,
+        "slices": {"<slice>": {"ok": true,
+                               "hbm_bytes_per_device": 4096}}
+      }
+    }
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+#: keys every lane record must carry, with their validators
+_LANE_REQUIRED = {
+    "ok": lambda v: isinstance(v, bool),
+    "peak_hbm_bytes": lambda v: isinstance(v, int) and v >= 0,
+    "donation": lambda v: isinstance(v, list),
+    "cost": lambda v: isinstance(v, dict),
+    "findings": lambda v: isinstance(v, dict),
+}
+
+
+def validate_memlint(doc) -> List[str]:
+    """Problems with one parsed MEMLINT document (empty = valid)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not a JSON object"]
+    if not isinstance(doc.get("round"), int):
+        problems.append("missing/invalid 'round' (int)")
+    if not isinstance(doc.get("platform"), str):
+        problems.append("missing/invalid 'platform' (str)")
+    lanes = doc.get("lanes")
+    if not isinstance(lanes, dict) or not lanes:
+        return problems + ["missing/empty 'lanes' object"]
+    for name, lane in lanes.items():
+        if not isinstance(lane, dict):
+            problems.append(f"lane {name!r} is not an object")
+            continue
+        for key, check in _LANE_REQUIRED.items():
+            if key not in lane:
+                problems.append(f"lane {name!r} missing {key!r}")
+            elif not check(lane[key]):
+                problems.append(f"lane {name!r} has invalid {key!r}: "
+                                f"{lane[key]!r}")
+        for entry in lane.get("donation") or []:
+            if not (isinstance(entry, dict) and "arg" in entry
+                    and isinstance(entry.get("aliased"), bool)):
+                problems.append(
+                    f"lane {name!r} donation entry malformed: "
+                    f"{entry!r}")
+                break
+        cost = lane.get("cost")
+        if isinstance(cost, dict) and cost:
+            for key in ("flops", "hbm_bytes"):
+                if not isinstance(cost.get(key), (int, float)):
+                    problems.append(
+                        f"lane {name!r} cost missing numeric {key!r}")
+    multi = doc.get("multichip")
+    if multi is not None:
+        if not isinstance(multi, dict) or \
+                not isinstance(multi.get("slices"), dict):
+            problems.append("'multichip' present but has no 'slices' "
+                            "object")
+        else:
+            for sname, rec in multi["slices"].items():
+                if not isinstance(rec, dict) or "ok" not in rec:
+                    problems.append(f"multichip slice {sname!r} "
+                                    f"malformed")
+    return problems
+
+
+def validate_memlint_file(path: str) -> List[str]:
+    """Problems with one MEMLINT_r*.json file (empty = valid)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable memlint JSON: {e}"]
+    return validate_memlint(doc)

@@ -1,0 +1,221 @@
+"""Compiled-HLO memory lint: static per-device HBM budgets.
+
+Mixed precision and fused optimizers are memory-bandwidth *and*
+memory-capacity plays: the point of donating the optimizer state is
+halving its HBM footprint, and the point of FSDP/pipeline sharding is
+fitting a step on a 16 GiB v5e at all.  Whether either actually
+happened is statically visible in the compiled executable —
+``Compiled.memory_analysis()`` is XLA's own buffer-assignment summary
+(argument + output + temp + aliased bytes, per device), and the
+``input_output_alias`` header says which donations the compiler
+honored.  This pass turns both into gateable findings so a lane fails
+lint on the host *before* it OOMs on chip.
+
+Finding codes (``op`` field):
+
+=====================  ==================================================
+``peak-hbm``           info: the per-device peak (argument + output +
+                       temp − aliased) with the full breakdown
+``hbm-budget``         error: peak exceeds ``budget_bytes`` (v5e 16 GiB
+                       default when a budget is requested)
+``donation-dropped``   error: a donated input the executable did NOT
+                       alias — the buffer is live twice (the request
+                       was checked by the ``donation`` pass; this is
+                       the *compiled outcome*)
+``donation-alias``     info: the per-argument donation-aliasing table
+``large-buffer``       info: the largest argument/output buffers, the
+                       attribution for an over-budget peak
+=====================  ==================================================
+
+The numbers come from the executable, not the HLO text: sharded
+programs report PER-DEVICE bytes (an FSDP-sharded 1 GiB parameter tree
+on 8 devices shows ~128 MiB/device), which is exactly the quantity a
+device budget constrains.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from apex_tpu.analysis.core import PassContext, register_pass
+from apex_tpu.analysis.donation import aliased_parameter_set, kept_index_map
+from apex_tpu.analysis.report import Finding
+
+#: v5e per-chip HBM — the default ``budget_bytes`` when a budget is
+#: requested without a number (``tools/graph_lint.py --memory-budget``).
+V5E_HBM_BYTES = 16 * (1 << 30)
+
+
+def memory_stats(compiled) -> "Optional[dict]":
+    """XLA's per-device memory summary of a compiled executable as a
+    plain dict, or ``None`` when the backend doesn't implement it.
+
+    ``peak_hbm_bytes`` is the static high-water estimate: arguments,
+    outputs and temps are all live across the step, minus the aliased
+    (donated-and-honored) bytes counted once instead of twice."""
+    try:
+        st = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 - backend-optional API
+        return None
+    if st is None:
+        return None
+    try:
+        out = {
+            "argument_bytes": int(st.argument_size_in_bytes),
+            "output_bytes": int(st.output_size_in_bytes),
+            "temp_bytes": int(st.temp_size_in_bytes),
+            "alias_bytes": int(st.alias_size_in_bytes),
+            "generated_code_bytes": int(st.generated_code_size_in_bytes),
+        }
+    except AttributeError:
+        return None
+    out["peak_hbm_bytes"] = (out["argument_bytes"] + out["output_bytes"]
+                             + out["temp_bytes"] - out["alias_bytes"])
+    return out
+
+
+def context_memory_stats(ctx: PassContext) -> "Optional[dict]":
+    """:func:`memory_stats` of the context's executable, memoized —
+    the memory pass and graph_lint's lane record share one XLA
+    memory-analysis run per lowering."""
+    return ctx.memo("memory_stats",
+                    lambda: memory_stats(ctx.compiled))
+
+
+def donation_table(ctx: PassContext) -> "Optional[List[dict]]":
+    """Per-donated-argument aliasing outcome from the compiled
+    executable: ``[{arg, dtype, bytes, aliased}]`` (empty when nothing
+    was donated or the program wasn't compiled, ``None`` when the
+    kept-argument numbering is ambiguous on this jax version — see
+    :func:`~apex_tpu.analysis.donation.kept_index_map`; guessing would
+    report honored donations as dropped).  ``bytes`` is the GLOBAL
+    logical buffer size from the traced signature.  Memoized on the
+    context — the memory pass and graph_lint's lane record both read
+    it from one lowering."""
+    def compute():
+        if ctx.hlo_text is None:
+            return []
+        donated = [a for a in ctx.kept_args if a.donated]
+        if not donated:
+            return []
+        kept_pos = kept_index_map(ctx)
+        if kept_pos is None:
+            return None
+        aliased = aliased_parameter_set(ctx)
+        return [{"arg": a.path or f"arg{a.index}", "dtype": a.dtype,
+                 "bytes": a.nbytes,
+                 "aliased": kept_pos[a.index] in aliased}
+                for a in donated]
+    return ctx.memo("donation_table", compute)
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20), ("KiB", 1 << 10)):
+        if abs(n) >= div:
+            return f"{n / div:.2f} {unit}"
+    return f"{n} B"
+
+
+def memory_pass(ctx: PassContext,
+                budget_bytes: Optional[int] = None,
+                top_k: int = 5) -> List[Finding]:
+    """Peak-HBM, budget, and donation-outcome lint over the compiled
+    executable (see module docstring for the finding codes).
+
+    ``budget_bytes`` arms the device-budget gate — pass the target
+    chip's HBM (:data:`V5E_HBM_BYTES` is the v5e default an FSDP or
+    pipeline lane should assert).  Without it the pass only measures.
+    """
+    if ctx.compiled is None:
+        # same lint-nothing escalation as the stats-None branch
+        # below: an ARMED budget that cannot run is a warning
+        return [Finding(
+            "memory", "warning" if budget_bytes is not None else "info",
+            "skipped: program was not compiled "
+            "(analyze(..., compile=True) to measure peak HBM)"
+            + (" — the requested budget gate asserted NOTHING"
+               if budget_bytes is not None else ""))]
+    findings: List[Finding] = []
+
+    stats = context_memory_stats(ctx)
+    if stats is None:
+        # with a budget ARMED this is a warning, not an info: the
+        # caller asked for an assertion that never executed (same
+        # lint-nothing-must-not-pass class as a typo'd lane list)
+        findings.append(Finding(
+            "memory", "warning" if budget_bytes is not None else "info",
+            "this backend exposes no memory_analysis(); peak-HBM "
+            "budget not checkable here"
+            + (" — the requested budget gate asserted NOTHING"
+               if budget_bytes is not None else "")))
+    else:
+        peak = stats["peak_hbm_bytes"]
+        findings.append(Finding(
+            "memory", "info",
+            f"per-device peak HBM {_fmt_bytes(peak)} (arguments "
+            f"{_fmt_bytes(stats['argument_bytes'])} + outputs "
+            f"{_fmt_bytes(stats['output_bytes'])} + temps "
+            f"{_fmt_bytes(stats['temp_bytes'])} − aliased "
+            f"{_fmt_bytes(stats['alias_bytes'])})",
+            op="peak-hbm", bytes=peak))
+        if budget_bytes is not None and peak > budget_bytes:
+            findings.append(Finding(
+                "memory", "error",
+                f"per-device peak HBM {_fmt_bytes(peak)} exceeds the "
+                f"device budget {_fmt_bytes(budget_bytes)} — this lane "
+                f"OOMs on chip; shard or donate more state (temps "
+                f"{_fmt_bytes(stats['temp_bytes'])}, un-aliased "
+                f"arguments "
+                f"{_fmt_bytes(stats['argument_bytes'] - stats['alias_bytes'])})",
+                op="hbm-budget", bytes=peak))
+
+    table = donation_table(ctx)
+    if table is None:
+        findings.append(Finding(
+            "memory", "info",
+            "donation outcomes unverifiable: kept-argument numbering "
+            "is ambiguous on this jax version (see the donation "
+            "pass)", op="donation-alias"))
+    elif table:
+        dropped = [t for t in table if not t["aliased"]]
+        findings.append(Finding(
+            "memory", "info",
+            f"donation-aliasing table: {len(table) - len(dropped)}/"
+            f"{len(table)} donated input(s) aliased by the compiler",
+            op="donation-alias", count=len(table)))
+        for t in dropped:
+            findings.append(Finding(
+                "memory", "error",
+                f"donated input {t['arg']} was NOT aliased by the "
+                f"compiled executable — {_fmt_bytes(t['bytes'])} of "
+                f"state is live twice per step",
+                op="donation-dropped", dtype=t["dtype"],
+                bytes=t["bytes"]))
+
+    # attribution: the largest live argument/output buffers (global
+    # logical sizes — the names a user can act on)
+    named = ([("argument", a.path or f"arg{a.index}", a.dtype, a.nbytes)
+              for a in ctx.kept_args]
+             + [("output", o.path or f"out{o.index}", o.dtype, o.nbytes)
+                for o in ctx.outputs])
+    named.sort(key=lambda t: -t[3])
+    for role, path, dtype, nbytes in named[:top_k]:
+        if nbytes <= 0:
+            continue
+        findings.append(Finding(
+            "memory", "info",
+            f"largest live buffers: {role} {path} holds "
+            f"{_fmt_bytes(nbytes)}",
+            op="large-buffer", dtype=dtype, bytes=nbytes))
+    return findings
+
+
+def per_device_stats(compiled) -> "Optional[dict]":
+    """Convenience for artifact writers (``__graft_entry__`` slice
+    records, ``tools/graph_lint.py --emit-json``): the
+    :func:`memory_stats` dict of a compiled executable, or ``None``
+    when the backend doesn't report memory."""
+    return memory_stats(compiled)
+
+
+register_pass("memory", memory_pass)

@@ -1,0 +1,227 @@
+"""Host-sync / retrace lint over the lowered step.
+
+A TPU train step is only as fast as its *quietest* iteration: one
+hidden host round-trip (an ``io_callback`` buried in a metrics helper,
+an infeed/outfeed pair, a ``jax.debug.print`` left enabled) serializes
+every step against the Python thread, and one retrace hazard (a
+``static_argnums`` step counter, a Python-literal scalar whose dtype
+drifts) recompiles the program mid-run.  Both classes are statically
+visible: callbacks lower to ``custom_call @xla_python_cpu_callback``
+(and friends) in the StableHLO/HLO text, infeed/outfeed are first-class
+ops, and the traced signature records which example arguments were
+bound statically or traced weak-typed.
+
+Finding codes (``op`` field):
+
+======================  =================================================
+``host-callback``       error: ``io_callback`` / ``host_callback`` /
+                        infeed / outfeed on the step path — a host
+                        sync every iteration
+``pure-callback``       warning: ``pure_callback`` — no ordering
+                        effect, but the value still round-trips
+                        through the host
+``debug-callback``      warning: ``jax.debug.print``/``callback`` —
+                        fine while debugging, a step-path sync when it
+                        ships
+``static-scalar``       warning: a numeric example argument was bound
+                        STATICALLY at trace time — every new value
+                        recompiles the step (step counters and loss
+                        scales must be dynamic; shape-determining
+                        statics are legitimate and can be ignored)
+``weak-scalar``         info: a 0-d argument traced from a Python
+                        literal (weak-typed) — passing a typed array
+                        for it later is a different signature and
+                        retraces
+``inplace-read-race``   info: donated-and-aliased buffers are updated
+                        in place; host reads of the INPUT array after
+                        dispatch race the step (the hazard
+                        ``resilience.durable``'s async save snapshots
+                        around)
+======================  =================================================
+
+The callback classification prefers the compiled HLO metadata
+(``op_name="...io_callback..."``) and falls back to StableHLO
+attributes (``has_side_effect`` + result arity) when the program
+wasn't compiled.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from apex_tpu.analysis.core import PassContext, register_pass
+from apex_tpu.analysis.donation import aliased_parameter_set, kept_index_map
+from apex_tpu.analysis.report import Finding
+
+#: custom-call targets that round-trip through the host. The python
+#: callback targets cover io/pure/debug callbacks on every backend
+#: (cpu/gpu/tpu spellings); the ffi variants are the jax>=0.5 names.
+_CALLBACK_TARGETS = (
+    "xla_python_cpu_callback", "xla_python_gpu_callback",
+    "xla_ffi_python_cpu_callback", "xla_ffi_python_gpu_callback",
+    "xla_python_tpu_callback", "tpu_host_callback",
+)
+
+_STABLEHLO_CC = re.compile(
+    r"stablehlo\.custom_call\s+@(?P<target>[\w.]+)\s*\((?P<operands>[^)]*)\)"
+    r"\s*(?P<attrs>\{.*?\})?\s*:\s*(?P<sig>.*)$")
+_HLO_CC = re.compile(
+    r'custom-call\(.*?custom_call_target="(?P<target>[^"]+)"')
+_HLO_OPNAME = re.compile(r'op_name="(?P<opname>[^"]*)"')
+_INFEED_RE = re.compile(
+    r"(?:stablehlo\.infeed|\binfeed(?:-token)?\()")
+_OUTFEED_RE = re.compile(
+    r"(?:stablehlo\.outfeed|\boutfeed(?:-token)?\()")
+
+
+def _classify_stablehlo(line: str) -> str:
+    """io / debug / pure from StableHLO attributes: an effectful call
+    with results is io_callback, effectful without results is a debug
+    print/callback, effect-free is pure_callback."""
+    effectful = "has_side_effect = true" in line
+    returns_values = not re.search(r"->\s*tuple<\s*>\s*$", line.strip())
+    if effectful and returns_values:
+        return "io"
+    if effectful:
+        return "debug"
+    return "pure"
+
+
+def _callback_findings(ctx: PassContext) -> List[Finding]:
+    found = []  # (kind, lineno, example)
+    if ctx.hlo_text is not None:
+        for lineno, line in enumerate(ctx.hlo_text.splitlines(), 1):
+            if "custom-call" not in line:
+                continue
+            m = _HLO_CC.search(line)
+            if not m or m.group("target") not in _CALLBACK_TARGETS:
+                continue
+            nm = _HLO_OPNAME.search(line)
+            opname = nm.group("opname") if nm else ""
+            if "io_callback" in opname or "host_callback" in opname:
+                kind = "io"
+            elif "debug" in opname:
+                kind = "debug"
+            elif "pure_callback" in opname:
+                kind = "pure"
+            else:
+                kind = "io"   # unknown host round-trip: assume the worst
+            found.append((kind, lineno, line.strip()[:160]))
+    else:
+        for lineno, line in enumerate(ctx.stablehlo_text.splitlines(), 1):
+            if "stablehlo.custom_call" not in line:
+                continue
+            m = _STABLEHLO_CC.search(line)
+            if not m or m.group("target") not in _CALLBACK_TARGETS:
+                continue
+            found.append((_classify_stablehlo(line), lineno,
+                          line.strip()[:160]))
+
+    sev = {"io": "error", "debug": "warning", "pure": "warning"}
+    label = {"io": "host-callback", "debug": "debug-callback",
+             "pure": "pure-callback"}
+    msg = {
+        "io": "io_callback/host_callback on the step path — the step "
+              "synchronizes with the Python thread every iteration",
+        "debug": "debug callback (jax.debug.print?) on the step path — "
+                 "a host sync when it ships; strip it from production "
+                 "steps",
+        "pure": "pure_callback on the step path — the value "
+                "round-trips through the host even without ordering "
+                "effects",
+    }
+    out = []
+    for kind, lineno, example in found:
+        out.append(Finding("syncs", sev[kind], msg[kind],
+                           op=label[kind], lineno=lineno,
+                           example=example))
+    return out
+
+
+def _feed_findings(ctx: PassContext) -> List[Finding]:
+    text = ctx.hlo_text if ctx.hlo_text is not None \
+        else ctx.stablehlo_text
+    out = []
+    for pattern, what in ((_INFEED_RE, "infeed"), (_OUTFEED_RE,
+                                                   "outfeed")):
+        hits = [i for i, line in enumerate(text.splitlines(), 1)
+                if pattern.search(line)]
+        if hits:
+            out.append(Finding(
+                "syncs", "error",
+                f"{what} op(s) inside the step — host-driven data "
+                f"feeding serializes the step against the host; use "
+                f"device-resident prefetch instead",
+                op="host-callback", count=len(hits), lineno=hits[0]))
+    return out
+
+
+def _retrace_findings(ctx: PassContext) -> List[Finding]:
+    out = []
+    for label, typename, value in ctx.static_scalars:
+        if label == "ambiguous":
+            # the traced signature cannot say WHICH argument was
+            # static — info, not warning: a false warning would tell
+            # the user to fix an already-dynamic argument
+            out.append(Finding(
+                "syncs", "info",
+                f"{value} — the traced signature cannot say which was "
+                f"bound statically; if one of the numeric candidates "
+                f"varies per step (step counter, loss scale) it "
+                f"recompiles on every new value and must be dynamic",
+                op="static-scalar"))
+            continue
+        out.append(Finding(
+            "syncs", "warning",
+            f"example argument {label}={value} ({typename}) was bound "
+            f"STATICALLY at trace time — every new value recompiles "
+            f"the step.  Step counters / loss scales must be dynamic "
+            f"args; shape-determining statics (sequence lengths, "
+            f"layer counts) are fine",
+            op="static-scalar"))
+    for a in ctx.kept_args:
+        if a.weak_type and a.shape == ():
+            out.append(Finding(
+                "syncs", "info",
+                f"scalar argument {a.path or a.index} traced from a "
+                f"Python literal (weak-typed {a.dtype}) — a typed "
+                f"array for the same argument is a different "
+                f"signature and retraces; pin it with "
+                f"jnp.asarray(v, dtype) if the producer varies",
+                op="weak-scalar", dtype=a.dtype))
+    return out
+
+
+def _inplace_race_findings(ctx: PassContext) -> List[Finding]:
+    if ctx.hlo_text is None:
+        return []
+    donated = [a for a in ctx.kept_args if a.donated]
+    if not donated:
+        return []
+    kept_pos = kept_index_map(ctx)
+    if kept_pos is None:   # ambiguous numbering: don't guess (see
+        return []          # donation.kept_index_map)
+    aliased = aliased_parameter_set(ctx)
+    inplace = [a for a in donated if kept_pos[a.index] in aliased]
+    if not inplace:
+        return []
+    total = sum(a.nbytes for a in inplace)
+    return [Finding(
+        "syncs", "info",
+        f"{len(inplace)} donated input(s) update in place "
+        f"({total} bytes): host reads of the INPUT arrays after "
+        f"dispatch race the step's in-place write — snapshot (or "
+        f"jax.block_until_ready) before any async consumer reads "
+        f"them, as resilience.durable's async save does",
+        op="inplace-read-race", bytes=total, count=len(inplace))]
+
+
+def syncs_pass(ctx: PassContext) -> List[Finding]:
+    """Host-sync, retrace-hazard, and in-place-read-race lint (see the
+    module docstring for the finding codes)."""
+    return (_callback_findings(ctx) + _feed_findings(ctx)
+            + _retrace_findings(ctx) + _inplace_race_findings(ctx))
+
+
+register_pass("syncs", syncs_pass)

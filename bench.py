@@ -822,6 +822,42 @@ def check_kernel_floor_artifact(search_dir: str) -> "dict | None":
                 "error": f"artifact unreadable: {e}"[:300]}
 
 
+def check_floor_calibration(search_dir: str) -> dict:
+    """The static half of gate calibration (apex_tpu.analysis.cost):
+    the published floors (MFU_FLOORS here, KERNEL_FLOORS in
+    tools/kernel_bench.py) and the measurements in the newest committed
+    KERNELBENCH/BENCH artifacts must all sit UNDER the cost-model
+    ceilings — a floor above the roofline (fraction > 1, MFU > 1) or a
+    measured bandwidth above the HBM peak means the gate was calibrated
+    against impossible physics, and every later round inherits the
+    miscalibration.  An unimportable audit is OUR bug: fail loudly
+    rather than run with the check silently off (same contract as
+    check_kernel_floor_artifact)."""
+    try:
+        from apex_tpu.analysis import cost as _cost
+    except Exception as e:  # noqa: BLE001
+        return {"ok": False,
+                "error": f"apex_tpu.analysis.cost unimportable: {e}"[:300]}
+    try:
+        tools_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools")
+        if tools_dir not in sys.path:
+            sys.path.insert(0, tools_dir)
+        import kernel_bench
+        kernel_floors = kernel_bench.KERNEL_FLOORS
+    except Exception as e:  # noqa: BLE001
+        # same fail-loud contract as the analysis.cost import above:
+        # an unimportable floor table means half the calibration gate
+        # is off, which must never read as "calibrated clean"
+        return {"ok": False,
+                "error": f"tools/kernel_bench unimportable — "
+                         f"KERNEL_FLOORS not audited: {e}"[:300]}
+    findings = _cost.audit_floor_artifacts(
+        search_dir, kernel_floors=kernel_floors, mfu_floors=MFU_FLOORS)
+    errors = [f.message for f in findings if f.severity == "error"]
+    return {"ok": not errors, "errors": errors}
+
+
 def find_prior_bench(search_dir: str) -> "str | None":
     """Newest ``BENCH_r{N}.json`` next to this script (by round number) —
     the default regression baseline when ``--compare`` isn't given."""
@@ -922,8 +958,10 @@ def gate_exit_code(regression_check: dict, compare_given: bool) -> int:
     in the output but informational."""
     mfu = regression_check.get("mfu_floors") or {}
     kfl = regression_check.get("kernel_floors") or {}
+    cal = regression_check.get("floor_calibration") or {}
     absolute_failed = bool(regression_check.get("ab_failures")) or \
-        not mfu.get("ok", True) or not kfl.get("ok", True)
+        not mfu.get("ok", True) or not kfl.get("ok", True) or \
+        not cal.get("ok", True)
     if absolute_failed or (compare_given
                            and not regression_check.get("ok", True)):
         return 2
@@ -1090,13 +1128,19 @@ def main(argv=None):
     # framework-attributable sign
     ab_failures = [n for n, v in configs.items()
                    if isinstance(v, dict) and v.get("ab_ok") is False]
+    # floors must sit under the cost-model ceiling (the lint analog:
+    # apex_tpu.analysis.cost — a roofline fraction or MFU floor above 1,
+    # or a committed measurement above physics, is a calibration bug)
+    calibration_check = check_floor_calibration(here)
     regression_check["mfu_floors"] = mfu_check
     regression_check["kernel_floors"] = kernel_floor_check
+    regression_check["floor_calibration"] = calibration_check
     regression_check["ab_failures"] = ab_failures
     regression_check["ok"] = bool(
         regression_check["ok"] and not ab_failures
         and (mfu_check is None or mfu_check["ok"])
-        and (kernel_floor_check is None or kernel_floor_check["ok"]))
+        and (kernel_floor_check is None or kernel_floor_check["ok"])
+        and calibration_check["ok"])
     if on_tpu and regression_check["ok"]:
         # a gate-failing run must not become the future like-for-like
         # baseline (a regressed rung would mask the loss once batches

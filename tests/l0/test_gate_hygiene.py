@@ -149,3 +149,62 @@ def test_repo_r02_incident_validates():
     """The pre-existing wedge record is the schema's reference instance;
     it must stay valid."""
     assert gate_hygiene._validate_incidents(str(REPO)) == []
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 4: MEMLINT_r*.json is gate memory too
+# ---------------------------------------------------------------------------
+
+def _memlint_module(repo):
+    """The schema validator the tmp repo's check will load — copy the
+    real one in, like a real checkout has."""
+    src = REPO / "apex_tpu" / "analysis" / "memlint.py"
+    dst = repo / "apex_tpu" / "analysis"
+    dst.mkdir(parents=True, exist_ok=True)
+    (dst / "memlint.py").write_text(src.read_text())
+
+
+def _valid_memlint():
+    return {"round": 4, "platform": "cpu", "lanes": {
+        "mlp_o1_train": {"ok": True, "peak_hbm_bytes": 10,
+                         "donation": [], "cost": {}, "findings": {}}}}
+
+
+def test_committed_memlint_validated_against_schema(tmp_repo):
+    """A committed MEMLINT_r*.json that does not validate (here: no
+    lanes at all) fails hygiene like a bad incident record."""
+    _memlint_module(tmp_repo)
+    (tmp_repo / "MEMLINT_r04_bad.json").write_text('{"round": 4}')
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "bad memlint")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("MEMLINT_r04_bad.json" in p
+               for p in verdict["invalid_memlints"])
+    assert gate_hygiene.main(["--repo", str(tmp_repo)]) == 1
+
+
+def test_valid_memlint_passes_schema(tmp_repo):
+    _memlint_module(tmp_repo)
+    (tmp_repo / "MEMLINT_r04_ok.json").write_text(
+        json.dumps(_valid_memlint()))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "good memlint")
+    assert gate_hygiene.check(str(tmp_repo))["ok"]
+
+
+def test_uncommitted_memlint_artifact_fails(tmp_repo):
+    """A fresh MEMLINT_rN.json is gate memory the moment it exists —
+    parked-but-untracked must fail like the KERNELBENCH artifacts do."""
+    _memlint_module(tmp_repo)
+    (tmp_repo / "MEMLINT_r05_new.json").write_text(
+        json.dumps(_valid_memlint()))
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert verdict["untracked"] == ["MEMLINT_r05_new.json"]
+
+
+def test_repo_memlint_validates():
+    """The committed MEMLINT artifact is the schema's reference
+    instance; it must stay valid."""
+    assert gate_hygiene._validate_memlints(str(REPO)) == []

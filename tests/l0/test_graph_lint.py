@@ -11,6 +11,7 @@ the compat surfaces (``amp.audit``, ``__graft_entry__._collective_audit``)
 are pinned by their own pre-existing suites.
 """
 
+import json
 import sys
 from pathlib import Path
 
@@ -377,4 +378,185 @@ def test_cli_main_runs_selected_family(capsys):
     import graph_lint
     assert graph_lint.main(["--families", "mlp"]) == 0
     out = capsys.readouterr().out
-    assert '"family": "mlp"' in out and '"ok": true' in out
+    assert '"lane": "mlp_o1"' in out and '"ok": true' in out
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 4: strict mode + every in-tree entry point lints clean
+# ---------------------------------------------------------------------------
+
+def test_cli_strict_mode_memory_budget_enforced(capsys):
+    """Tier-1 strict-mode run over the smallest family: the memlint
+    passes execute with the v5e 16 GiB device budget ARMED (bare
+    ``--memory-budget``), so every tier-1 run proves the memory/cost/
+    syncs passes fire on a real lane and the lane fits the chip."""
+    import graph_lint
+    assert graph_lint.main(["--families", "mlp", "--lanes", "o1,o2",
+                            "--memory-budget"]) == 0
+    out = capsys.readouterr().out
+    assert '"lane": "mlp_o1"' in out and '"lane": "mlp_o2"' in out
+    for line in out.splitlines():
+        rec = json.loads(line)
+        assert {"memory", "cost", "syncs"} <= set(rec["passes"])
+        assert rec["ok"], rec
+
+
+def test_cli_memory_budget_violation_fails_exit_code(capsys):
+    import graph_lint
+    assert graph_lint.main(["--families", "mlp", "--lanes", "o1",
+                            "--memory-budget", "1KiB"]) == 1
+    out = capsys.readouterr().out
+    assert '"hbm-budget"' in out
+
+
+def test_parse_bytes_forms():
+    import graph_lint
+    assert graph_lint.parse_bytes("1048576") == 1 << 20
+    assert graph_lint.parse_bytes("16GiB") == 16 << 30
+    assert graph_lint.parse_bytes("512MiB") == 512 << 20
+    assert graph_lint.parse_bytes("2GB") == 2 * 10**9
+    with pytest.raises(ValueError):
+        graph_lint.parse_bytes("lots")
+
+
+def test_cli_emit_json_rejects_partial_modes(tmp_path):
+    """--emit-json commits the full-matrix artifact; a restricted
+    --passes or --no-compile run must be refused, never silently
+    overridden into a partial document."""
+    import graph_lint
+    with pytest.raises(SystemExit):
+        graph_lint.main(["--emit-json", str(tmp_path / "M_r99.json"),
+                         "--no-compile"])
+    with pytest.raises(SystemExit):
+        graph_lint.main(["--emit-json", str(tmp_path / "M_r99.json"),
+                         "--passes", "donation"])
+    with pytest.raises(SystemExit):
+        graph_lint.main(["--emit-json", str(tmp_path / "M_r99.json"),
+                         "--families", "mlp"])
+    with pytest.raises(SystemExit):
+        graph_lint.main(["--emit-json", str(tmp_path / "M_r99.json"),
+                         "--lanes", "o1"])
+    assert not (tmp_path / "M_r99.json").exists()
+
+
+def test_cli_emit_json_defaults_budget_armed(monkeypatch, tmp_path):
+    """--emit-json without --memory-budget arms the v5e default — a
+    regeneration must never quietly replace a budget-gated round with
+    an unarmed one."""
+    import graph_lint
+    seen = {}
+
+    def fake_emit(path, families, memory_budget=None, verbose=False):
+        seen["budget"] = memory_budget
+        return 0
+
+    monkeypatch.setattr(graph_lint, "emit_memlint", fake_emit)
+    assert graph_lint.main(
+        ["--emit-json", str(tmp_path / "M_r99.json")]) == 0
+    from apex_tpu.analysis.memory import V5E_HBM_BYTES
+    assert seen["budget"] == V5E_HBM_BYTES
+
+
+def test_cli_no_compile_rejects_armed_budget():
+    """--memory-budget + --no-compile: the budget gate can't run
+    without the compiled executable — refuse the combination rather
+    than exit 0 having asserted nothing."""
+    import graph_lint
+    with pytest.raises(SystemExit):
+        graph_lint.main(["--families", "mlp", "--lanes", "o1",
+                         "--no-compile", "--memory-budget", "1KiB"])
+
+
+def test_memory_pass_uncompiled_armed_budget_warns():
+    """analyze(compile=False) with budget_bytes armed: the skip is a
+    WARNING naming the unasserted gate, not a bare info."""
+    from apex_tpu import analysis
+    rep = analysis.analyze(lambda x: x * 2, jnp.ones((4,)),
+                           compile=False, passes=("memory",),
+                           options={"memory": {"budget_bytes": 1024}})
+    skips = rep.by_pass("memory")
+    assert len(skips) == 1 and skips[0].severity == "warning"
+    assert "asserted NOTHING" in skips[0].message
+    # without a budget the same skip stays informational
+    rep2 = analysis.analyze(lambda x: x * 2, jnp.ones((4,)),
+                            compile=False, passes=("memory",))
+    assert rep2.by_pass("memory")[0].severity == "info"
+
+
+def test_cli_zero_applicable_passes_fails(capsys):
+    """``--passes policy --lanes o2``: policy only applies to O1
+    forwards, so every selected lane would run ZERO passes — the
+    lint-nothing-and-pass class the --lanes guard exists to stop must
+    fail here too."""
+    import graph_lint
+    assert graph_lint.main(["--families", "mlp", "--passes", "policy",
+                            "--lanes", "o2"]) == 1
+    captured = capsys.readouterr()
+    assert "ran zero passes" in captured.err
+
+
+def test_cli_policy_only_with_default_lanes_still_passes(capsys):
+    """``--passes policy`` without ``--lanes``: the default lane list
+    includes decode lanes that can't host the policy pass — those are
+    SKIPPED (never printed as ok), while the O1 lane runs policy and
+    the invocation exits 0 (the pre-PR behavior)."""
+    import graph_lint
+    assert graph_lint.main(["--families", "mlp",
+                            "--passes", "policy"]) == 0
+    captured = capsys.readouterr()
+    assert '"lane": "mlp_o1"' in captured.out
+    assert "decode_b1" not in captured.out      # no ok:true for a skip
+    assert "skipped: no requested pass applies" in captured.err
+
+
+def test_multichip_slice_table_refuses_missing_mesh(monkeypatch):
+    """Fewer CPU devices than the virtual mesh needs (backend
+    initialized before XLA_FLAGS could act): fail loudly rather than
+    commit wrong per-device numbers under an n_devices: 8 header."""
+    import graph_lint
+    one = jax.devices("cpu")[:1]
+    monkeypatch.setattr(graph_lint.jax, "devices",
+                        lambda *a, **k: one)
+    with pytest.raises(RuntimeError, match="need 8 CPU devices"):
+        graph_lint.multichip_slice_table(8)
+
+
+#: every in-tree lint entry point: the four families at both opt
+#: levels plus the decode lanes — the parametrized "runs clean over
+#: every example entry point" guarantee (the ResNet-50 ``entry()``
+#: forward is the slow-marked flagship below).
+ENTRY_POINTS = ([(f, o) for f in ["mlp", "resnet", "gpt", "bert"]
+                 for o in ["O1", "O2"]]
+                + [("decode_b1", None), ("decode_b2", None)])
+
+
+@pytest.mark.parametrize("name,opt_level", ENTRY_POINTS,
+                         ids=[f"{n}_{o}" if o else n
+                              for n, o in ENTRY_POINTS])
+def test_every_entry_point_lints_clean(name, opt_level):
+    import graph_lint
+    if opt_level is None:
+        report = graph_lint.lint_decode(
+            name, memory_budget=graph_lint.memory_mod.V5E_HBM_BYTES)
+    else:
+        report = graph_lint.lint_family(
+            name, opt_level=opt_level,
+            memory_budget=graph_lint.memory_mod.V5E_HBM_BYTES)
+    assert report.ok, report.format()
+    assert any(f.op == "peak-hbm" for f in report.by_pass("memory"))
+
+
+@pytest.mark.slow
+def test_flagship_entry_forward_lints_clean():
+    """``__graft_entry__.entry()`` — the ResNet-50 bf16 forward the
+    driver compiles — through the full non-policy pass list."""
+    sys.path.insert(0, str(REPO))
+    import __graft_entry__ as graft
+    fwd, args = graft.entry()
+    rep = analysis.analyze(
+        fwd, *args,
+        passes=("donation", "collectives", "constant-capture",
+                "memory", "cost", "syncs"),
+        options={"memory": {"budget_bytes": 16 << 30},
+                 "collectives": {"budget": {"total": 0}}})
+    assert rep.ok, rep.format()

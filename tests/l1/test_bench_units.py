@@ -493,3 +493,16 @@ def test_check_kernel_floor_artifact_reads_committed_round():
         assert broken["ok"] and "error" in broken
         assert bench.check_kernel_floor_artifact(
             tempfile.gettempdir() + "/definitely_empty_dir_xyz") is None
+
+
+def test_check_floor_calibration_fails_loud_on_unimportable_floors(
+        monkeypatch):
+    """An unimportable KERNEL_FLOORS table must fail the calibration
+    gate, never silently run with the floor half of the check off
+    (the fail-loud contract in the docstring)."""
+    ok = bench.check_floor_calibration(str(REPO))
+    assert ok["ok"], ok
+    monkeypatch.setitem(sys.modules, "kernel_bench", None)
+    broken = bench.check_floor_calibration(str(REPO))
+    assert not broken["ok"]
+    assert "KERNEL_FLOORS not audited" in broken["error"]

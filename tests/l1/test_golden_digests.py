@@ -9,6 +9,13 @@ baseline is intentionally regenerated:
 The baseline is platform-specific (XLA:CPU vs XLA:TPU produce different —
 each internally deterministic — float sequences); configs are compared only
 on the platform they were recorded on and skipped elsewhere.
+
+Each re-pinned digest carries a one-line provenance note in the
+``_provenance`` block of ``golden_digests.json`` naming what moved it,
+and a drift (:func:`explain_drift`) reports the old/new fingerprint,
+the first diverging iteration, and the suspected knob — the config's
+fused-kernel geometry axis when it has one, the XLA:CPU environment
+when it doesn't — instead of a bare mismatch.
 """
 
 import json
@@ -83,6 +90,67 @@ def _load():
     return json.loads(GOLDEN.read_text())
 
 
+def suspect_knob(cfg_kwargs: dict) -> str:
+    """The geometry/config axis most likely behind a drift for this
+    config — fused kernels first (their block geometry is the only
+    numerics-relevant tuning surface), the XLA:CPU environment when the
+    config exercises no fused kernel at all."""
+    if cfg_kwargs.get("fused_adam"):
+        return ("fused-adam block geometry "
+                "(apex_tpu/ops/pallas/geometry.py selector / ADAM_PAD)")
+    if cfg_kwargs.get("with_bn"):
+        return ("batch-norm statistics path / layer-norm kernel row "
+                "blocking (dγ/dβ accumulation order is digest contract)")
+    return ("XLA:CPU codegen environment (no fused kernel in this "
+            "config: SGD + jnp reference path)")
+
+
+def explain_drift(name: str, cfg_kwargs: dict, want: dict,
+                  got: dict) -> str:
+    """Old/new digest, first diverging iteration, and the suspected
+    knob — what a triager needs before deciding regenerate-vs-revert."""
+    def differs(a, b):
+        # NaN is a legitimate stored value (overflow-inject configs
+        # record it by design): NaN-vs-NaN is a MATCH, not the
+        # divergence point
+        if isinstance(a, float) and isinstance(b, float) \
+                and np.isnan(a) and np.isnan(b):
+            return False
+        return a != b
+
+    diverge = next((i for i, (a, b) in enumerate(
+        zip(want["losses"], got["losses"])) if differs(a, b)),
+        None)
+    if diverge is None and len(want["losses"]) != len(got["losses"]):
+        # zip truncates to the shorter run — a missing/extra iteration
+        # IS the divergence point, not a loss match
+        diverge = min(len(want["losses"]), len(got["losses"]))
+    lines = [
+        f"numerical drift vs stored baseline for {name}:",
+        f"  stored fingerprint:  {want['fingerprint']}",
+        f"  current fingerprint: {got['fingerprint']}",
+        f"  first diverging iteration: "
+        f"{'none (loss match; scales/overflows differ)' if diverge is None else diverge}",
+    ]
+    if diverge is not None:
+        def at(xs, i):
+            return repr(xs[i]) if i < len(xs) else \
+                f"<absent — run has {len(xs)} iteration(s)>"
+        lines.append(f"    stored[{diverge}]={at(want['losses'], diverge)}"
+                     f" current[{diverge}]={at(got['losses'], diverge)}")
+    lines += [
+        f"  suspected knob: {suspect_knob(cfg_kwargs)}",
+        f"  stored losses:  {want['losses']}",
+        f"  current losses: {got['losses']}",
+        f"  stored scales:  {want['scales']}",
+        f"  current scales: {got['scales']}",
+        "If this change is intentional, regenerate with "
+        "APEX_TPU_REGEN_GOLDEN=1, commit the new golden_digests.json, "
+        "and record the cause in its _provenance block.",
+    ]
+    return "\n".join(lines)
+
+
 @pytest.mark.parametrize("name", sorted(CONFIGS))
 def test_golden_digest(name):
     platform = jax.devices()[0].platform
@@ -97,15 +165,49 @@ def test_golden_digest(name):
                     f"regenerate with APEX_TPU_REGEN_GOLDEN=1")
     want = stored[platform][name]
     got = _record(CONFIGS[name])
-    assert got["fingerprint"] == want["fingerprint"], (
-        f"numerical drift vs stored baseline for {name}:\n"
-        f"  stored losses: {want['losses']}\n"
-        f"  current losses: {got['losses']}\n"
-        f"  stored scales: {want['scales']}\n"
-        f"  current scales: {got['scales']}\n"
-        "If this change is intentional, regenerate with "
-        "APEX_TPU_REGEN_GOLDEN=1 and commit the new golden_digests.json.")
+    assert got["fingerprint"] == want["fingerprint"], \
+        explain_drift(name, CONFIGS[name], want, got)
     # redundant with the fingerprint, but gives a readable diff on failure
     np.testing.assert_array_equal(got["losses"], want["losses"])
     np.testing.assert_array_equal(got["scales"], want["scales"])
     assert got["overflows"] == want["overflows"]
+
+
+def test_explain_drift_names_digests_and_knob():
+    """The drift report must carry old/new fingerprint, the first
+    diverging iteration, and the suspected knob — never a bare
+    mismatch."""
+    want = {"fingerprint": 111, "losses": [1.0, 2.0, 3.0],
+            "scales": [128.0], "overflows": [False]}
+    got = {"fingerprint": 222, "losses": [1.0, 2.5, 3.0],
+           "scales": [128.0], "overflows": [False]}
+    msg = explain_drift("o2_x", {"fused_adam": True}, want, got)
+    assert "111" in msg and "222" in msg
+    assert "first diverging iteration: 1" in msg
+    # NaN stored AND current (overflow-inject configs) is a match, not
+    # the divergence point
+    nan_want = {**want, "losses": [1.0, float("nan"), 3.0]}
+    nan_got = {**got, "losses": [1.0, float("nan"), 3.5]}
+    assert "first diverging iteration: 2" in explain_drift(
+        "o1_overflow_inject", {}, nan_want, nan_got)
+    # a run shorter than the baseline diverges at the truncation
+    # point — never "none (loss match...)"
+    short_got = {**got, "losses": [1.0, 2.0]}
+    short_msg = explain_drift("o0_fp32", {}, want, short_got)
+    assert "first diverging iteration: 2" in short_msg
+    assert "<absent" in short_msg and "none (loss match" not in short_msg
+    assert "geometry" in msg and "_provenance" in msg
+    assert "fused-adam" in suspect_knob({"fused_adam": True})
+    assert "batch-norm" in suspect_knob({"with_bn": True})
+    assert "XLA:CPU" in suspect_knob({})
+
+
+def test_repinned_digests_carry_provenance():
+    """Every digest re-pinned at PR 4 has a one-line provenance note;
+    the note names where the old value came from."""
+    stored = _load()
+    prov = stored.get("_provenance", {})
+    for name in ("o0_fp32", "o0_bn_fp32", "o1_dynamic", "o1_static128",
+                 "o1_bn_dynamic", "o1_overflow_inject"):
+        assert f"cpu/{name}" in prov, f"missing provenance for {name}"
+        assert "round-5 host" in prov[f"cpu/{name}"]

@@ -16,7 +16,13 @@ This check fails (exit 1) when
 - a committed ``INCIDENT_r*.json`` does not validate against the
   incident schema (``apex_tpu/resilience/incidents.py``: status, utc or
   date, non-empty evidence) — chaos-run artifacts must not rot into
-  prose nobody can machine-check.
+  prose nobody can machine-check, or
+- a committed ``MEMLINT_r*.json`` does not validate against the
+  memory-lint schema (``apex_tpu/analysis/memlint.py``: round,
+  platform, non-empty lanes each carrying ``peak_hbm_bytes`` / the
+  donation-aliasing table / cost-model numbers) — the static HBM
+  story of every lane is gate memory the same way the kernel floors
+  are.
 
 It is wired into tier-1 (``tests/l0/test_gate_hygiene.py``), so a round
 cannot go green with dirty gate memory.  Best-effort on the VCS side:
@@ -47,27 +53,52 @@ REQUIRED = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json")
 #: evidence the same way).
 PATTERNS = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json",
             "BENCH_VARIANCE.json", "KERNELBENCH_r*.json",
-            "BENCH_r*.json", "INCIDENT_r*.json")
+            "BENCH_r*.json", "INCIDENT_r*.json", "MEMLINT_r*.json")
 
 #: Round-numbered incident artifacts additionally get schema-validated.
 INCIDENT_PATTERN = "INCIDENT_r*.json"
 
+#: ... and so do the memory-lint artifacts (graph_lint --emit-json).
+MEMLINT_PATTERN = "MEMLINT_r*.json"
+
+
+def _load_by_path(repo: str, *rel: str):
+    """Load a stdlib-only schema module directly by file path so this
+    tool never imports jax; ``None`` outside a full checkout."""
+    import importlib.util
+    mod_path = Path(repo).joinpath(*rel)
+    if not mod_path.exists():  # best-effort outside a full checkout
+        return None
+    spec = importlib.util.spec_from_file_location(
+        "_apex_" + mod_path.stem, mod_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
 
 def _validate_incidents(repo: str) -> "list[str]":
     """Schema problems over every present INCIDENT_r*.json, as
-    ``path: problem`` strings.  Loads the stdlib-only schema module
-    directly by file path so this tool never imports jax."""
-    import importlib.util
-    mod_path = Path(repo) / "apex_tpu" / "resilience" / "incidents.py"
-    if not mod_path.exists():  # best-effort outside a full checkout
+    ``path: problem`` strings."""
+    incidents = _load_by_path(repo, "apex_tpu", "resilience",
+                              "incidents.py")
+    if incidents is None:
         return []
-    spec = importlib.util.spec_from_file_location("_apex_incidents",
-                                                  mod_path)
-    incidents = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(incidents)
     problems = []
     for p in sorted(Path(repo).glob(INCIDENT_PATTERN)):
         for msg in incidents.validate_incident_file(str(p)):
+            problems.append(f"{p.name}: {msg}")
+    return problems
+
+
+def _validate_memlints(repo: str) -> "list[str]":
+    """Schema problems over every present MEMLINT_r*.json, as
+    ``path: problem`` strings (``apex_tpu/analysis/memlint.py``)."""
+    memlint = _load_by_path(repo, "apex_tpu", "analysis", "memlint.py")
+    if memlint is None:
+        return []
+    problems = []
+    for p in sorted(Path(repo).glob(MEMLINT_PATTERN)):
+        for msg in memlint.validate_memlint_file(str(p)):
             problems.append(f"{p.name}: {msg}")
     return problems
 
@@ -88,13 +119,15 @@ def _git(repo: str, *args: str) -> "str | None":
 
 def check(repo: str = str(REPO)) -> dict:
     """``{"ok": bool, "missing": [...], "untracked": [...],
-    "dirty": [...], "invalid_incidents": [...]}`` — see the module
-    docstring for the rules."""
+    "dirty": [...], "invalid_incidents": [...],
+    "invalid_memlints": [...]}`` — see the module docstring for the
+    rules."""
     tracked_raw = _git(repo, "ls-files", "--", *PATTERNS)
     if tracked_raw is None:
         return {"ok": True, "skipped": "not a git checkout (or no git): "
                                        "hygiene unverifiable", "missing": [],
-                "untracked": [], "dirty": [], "invalid_incidents": []}
+                "untracked": [], "dirty": [], "invalid_incidents": [],
+                "invalid_memlints": []}
     tracked = set(tracked_raw.split())
     missing = [f for f in REQUIRED
                if not (Path(repo) / f).exists() or f not in tracked]
@@ -115,9 +148,12 @@ def check(repo: str = str(REPO)) -> dict:
         else:
             dirty.append(path)
     invalid = _validate_incidents(repo)
-    return {"ok": not (missing or untracked or dirty or invalid),
+    invalid_mem = _validate_memlints(repo)
+    return {"ok": not (missing or untracked or dirty or invalid
+                       or invalid_mem),
             "missing": missing, "untracked": untracked, "dirty": dirty,
-            "invalid_incidents": invalid}
+            "invalid_incidents": invalid,
+            "invalid_memlints": invalid_mem}
 
 
 def main(argv=None) -> int:
@@ -130,7 +166,9 @@ def main(argv=None) -> int:
         print("gate_hygiene: gate-baseline artifacts must be committed — "
               f"missing/untracked {verdict['missing'] + verdict['untracked']},"
               f" modified {verdict['dirty']}; invalid incident records "
-              f"{verdict.get('invalid_incidents', [])}", file=sys.stderr)
+              f"{verdict.get('invalid_incidents', [])}; invalid memlint "
+              f"records {verdict.get('invalid_memlints', [])}",
+              file=sys.stderr)
         return 1
     return 0
 

@@ -1,34 +1,53 @@
-"""Graph lint over the in-tree model families' O1 train steps.
+"""Graph lint over the in-tree model families' train and decode lanes.
 
 Runs every :mod:`apex_tpu.analysis` pass over the four model families
 (MLP, ResNet, GPT, BERT — tiny configs, CPU-safe, seconds per family):
 
 - the **graph passes** (donation, sharding, collectives,
-  constant-capture) run on the full O1 ``amp.make_train_step`` program
-  with the Amp state donated — the program production actually runs,
-  lowered and compiled on the host backend (no device execution);
+  constant-capture) and the **memlint passes** (memory, cost, syncs)
+  run on the full O1/O2 ``amp.make_train_step`` programs with the Amp
+  state donated — the program production actually runs, lowered and
+  compiled ONCE per lane on the host backend (no device execution);
+  every pass shares that single :class:`~apex_tpu.analysis.PassContext`;
 - the **policy pass** runs on the O1 *forward* (the audit's documented
   scope — the AD-generated backward legitimately accumulates in the
   wire dtype, see ``apex_tpu/analysis/policy.py``), sharing the model
-  builders with ``tools/policy_audit.py``.
+  builders with ``tools/policy_audit.py``;
+- the **decode lanes** lint the jitted KV-cached generation step
+  (``apex_tpu.models.generate._generate_impl``) at bench-shaped tiny
+  configs, and ``--emit-json`` additionally lowers the
+  ``dryrun_multichip`` slices on the 8-device virtual CPU mesh to
+  record each slice's static per-device HBM.
 
 Per-family collective byte budgets are pinned at zero: a single-chip
 train step has no collectives, so ANY appearing is a comm-volume
 regression (multi-chip programs get their budgets where their meshes
 are built — the dryrun slices in ``__graft_entry__.py``).
 
-One JSON line per family plus a human summary; exit 1 on any finding of
+``--memory-budget [BYTES]`` arms the per-device peak-HBM gate on every
+lane (bare flag = the v5e 16 GiB default; suffixes ``KiB``/``MiB``/
+``GiB`` accepted).  ``--emit-json MEMLINT_rN.json`` writes the
+committed memory-lint artifact — per-lane ``peak_hbm_bytes``,
+donation-aliasing table, cost-model flops/bytes, the multichip slice
+table, and the gate-calibration audit (committed KERNELBENCH/BENCH
+floors must sit under the cost-model ceiling) — validated by
+``tools/gate_hygiene.py`` against ``apex_tpu/analysis/memlint.py``.
+
+One JSON line per lane plus a human summary; exit 1 on any finding of
 ``error`` severity — wired as ``tests/l0/test_graph_lint.py`` so the
 clean-program guarantee is continuously enforced.
 
 Usage:
     python tools/graph_lint.py [--families mlp,gpt] [--passes donation,...]
-                               [--no-compile] [-v]
+                               [--lanes o1,o2,decode] [--no-compile]
+                               [--memory-budget [BYTES]]
+                               [--emit-json MEMLINT_r01.json] [-v]
 """
 
 import argparse
 import json
 import os
+import re
 import sys
 from pathlib import Path
 
@@ -39,19 +58,31 @@ sys.path.insert(0, str(Path(__file__).resolve().parent))
 # the caller pins a real chip (same env knob as the test suite).  Must
 # happen before any jax backend initialization; the env-level
 # JAX_PLATFORMS pin (sitecustomize) is overridden at the config level.
+# The multichip lanes additionally need 8 virtual host devices, which
+# only an XLA_FLAGS set before backend init can provide.
 os.environ.setdefault("APEX_TPU_KERNELS", "jnp")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
 
 jax.config.update("jax_platforms",
                   os.environ.get("APEX_TPU_TEST_PLATFORM", "cpu"))
 
 from apex_tpu import amp, analysis  # noqa: E402
+from apex_tpu.analysis import cost as cost_mod  # noqa: E402
+from apex_tpu.analysis import memory as memory_mod  # noqa: E402
 from apex_tpu.optimizers import FusedAdam  # noqa: E402
 
 import policy_audit  # noqa: E402  (sibling tool: shared model builders)
 
 GRAPH_PASSES = ("donation", "sharding", "collectives", "constant-capture")
-ALL_PASSES = GRAPH_PASSES + ("policy",)
+#: the compiled-evidence memory/cost/sync passes — run on every lane,
+#: sharing the lane's single lowering+compilation with the graph passes
+MEMLINT_PASSES = ("memory", "cost", "syncs")
+ALL_PASSES = GRAPH_PASSES + MEMLINT_PASSES + ("policy",)
 
 #: single-chip train steps imply ZERO collective bytes; any regression
 #: that introduces one (an accidental psum, a sharding annotation leak)
@@ -61,39 +92,272 @@ COLLECTIVE_BUDGETS = {"mlp": {"total": 0}, "resnet": {"total": 0},
 
 FAMILIES = tuple(policy_audit.RAW_CASES)
 
+#: decode lanes: (batch, prefill, new_tokens) at the tiny config — the
+#: static analog of the bench's gpt_small_tpu_decode_b{1,8} lanes.
+DECODE_LANES = {"decode_b1": (1, 8, 8), "decode_b2": (2, 8, 8)}
 
-def build_train_step(family: str, raw=None):
-    """(jitted_step, example_args): the full O1 train step — FusedAdam,
-    dynamic loss scaling, Amp state donated — for one model family.
-    ``raw`` reuses an already-built ``(loss_fn, params, batch)``."""
+
+def build_train_step(family: str, raw=None, opt_level: str = "O1"):
+    """(jitted_step, example_args): the full train step — FusedAdam,
+    dynamic loss scaling, Amp state donated — for one model family at
+    ``opt_level``.  ``raw`` reuses an already-built
+    ``(loss_fn, params, batch)``."""
     loss_fn, params, batch = raw or policy_audit.RAW_CASES[family]()
-    a = amp.initialize(optimizer=FusedAdam(lr=1e-3), opt_level="O1",
+    a = amp.initialize(optimizer=FusedAdam(lr=1e-3), opt_level=opt_level,
                        verbosity=0)
     state = a.init(params)
     step = jax.jit(amp.make_train_step(a, loss_fn), donate_argnums=0)
     return step, (state, *batch)
 
 
-def lint_family(family: str, passes=ALL_PASSES, compile: bool = True):
+def build_decode_step(batch: int = 1, prefill: int = 8,
+                      new_tokens: int = 8):
+    """(jitted_decode, args, kwargs): the KV-cached generation step at
+    a tiny config in the bf16 serving layout — the program
+    ``apex_tpu.models.generate.generate`` dispatches."""
+    from importlib import import_module
+    gen = import_module("apex_tpu.models.generate")   # the module —
+    # ``apex_tpu.models`` re-exports the ``generate`` FUNCTION under
+    # the same name, shadowing a ``from ... import generate``
+    from apex_tpu.models.gpt import GPTModel, gpt_tiny
+
+    cfg = gpt_tiny()
+    model = GPTModel(cfg)
+    prompt = jnp.zeros((batch, prefill), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    a = amp.initialize(opt_level="O2", verbosity=0)
+    params = a.model_params_from(params)   # bf16, the serving layout
+    stacked = gen._stack_layer_params(params, cfg.num_layers)
+    top = {k: v for k, v in params.items()
+           if not k.startswith("block_") and k != "layers"}
+    args = (top, stacked, prompt, jnp.float32(0.0),
+            jax.random.PRNGKey(0))
+    kwargs = dict(cfg=cfg, max_new_tokens=new_tokens, sample=False)
+    return gen._generate_impl, args, kwargs
+
+
+def _memlint_options(memory_budget=None):
+    opts = {}
+    if memory_budget is not None:
+        opts["memory"] = {"budget_bytes": int(memory_budget)}
+    return opts
+
+
+def _lane_record(ctx, report) -> dict:
+    """The MEMLINT lane record for one analyzed program (see
+    ``apex_tpu/analysis/memlint.py`` for the schema)."""
+    stats = memory_mod.context_memory_stats(ctx) \
+        if ctx.compiled is not None else None
+    ct = cost_mod.context_cost_table(ctx) \
+        if ctx.compiled is not None else None
+    rec = {
+        "ok": report.ok,
+        "peak_hbm_bytes": int(stats["peak_hbm_bytes"]) if stats else 0,
+        "breakdown": {k: v for k, v in (stats or {}).items()
+                      if k != "peak_hbm_bytes"},
+        # None = numbering ambiguous on this jax version; the memory
+        # pass records that as its own finding
+        "donation": memory_mod.donation_table(ctx) or [],
+        "cost": ct or {},
+        "findings": report.to_dict()["counts"],
+    }
+    return rec
+
+
+def lint_family(family: str, passes=ALL_PASSES, compile: bool = True,
+                opt_level: str = "O1", memory_budget=None,
+                raw=None, _collect=None):
     """Run the requested passes over one family; returns the merged
-    :class:`~apex_tpu.analysis.Report` (train-step graph passes +
-    forward policy pass).  The model is built once and shared between
-    the two analyzed programs."""
-    graph = tuple(p for p in passes if p != "policy")
-    raw = loss_fn, params, batch = policy_audit.RAW_CASES[family]()
+    :class:`~apex_tpu.analysis.Report` (train-step graph+memlint passes
+    + forward policy pass).  The model is built once (``raw`` reuses an
+    already-built ``(loss_fn, params, batch)`` across lanes); the train
+    step is lowered ONCE and compiled at most once, and every
+    non-policy pass shares that PassContext (the policy pass analyzes
+    the forward — a different program — and is the only second
+    lowering)."""
+    step_passes = tuple(p for p in passes if p != "policy")
+    run_policy = "policy" in passes and opt_level == "O1"
+    if not step_passes and not run_policy:
+        # nothing to run on this lane: skip before paying the model
+        # build (main() reports the empty report as a skipped lane)
+        return analysis.Report()
+    raw = loss_fn, params, batch = \
+        raw or policy_audit.RAW_CASES[family]()
     report = analysis.Report()
-    if graph:
-        step, args = build_train_step(family, raw=raw)
-        report = analysis.analyze(
-            step, *args, passes=graph, compile=compile,
-            options={"collectives":
-                     {"budget": COLLECTIVE_BUDGETS.get(family, {})}})
-    if "policy" in passes:
+    ctx = None
+    if step_passes:
+        step, args = build_train_step(family, raw=raw,
+                                      opt_level=opt_level)
+        lowered = analysis.lower_quiet(step, *args)
+        ctx = analysis.build_context(lowered, compile=compile)
+        options = {"collectives":
+                   {"budget": COLLECTIVE_BUDGETS.get(family, {})}}
+        options.update(_memlint_options(memory_budget))
+        report = analysis.run_passes(ctx, passes=step_passes,
+                                     options=options)
+    if run_policy:
         a = amp.initialize(opt_level="O1", verbosity=0)
         fwd = lambda p, *b: a.run(loss_fn, p, *b)  # noqa: E731
         report = report.merged(analysis.analyze(
             fwd, params, *batch, passes=("policy",), compile=False))
+    if _collect is not None and ctx is not None:
+        # the MERGED report: a policy error must show in the lane
+        # record's ok/findings, or the CLI's "see the artifact"
+        # failure message would point at a clean document
+        _collect[f"{family}_{opt_level.lower()}_train"] = \
+            _lane_record(ctx, report)
     return report
+
+
+def lint_decode(lane: str, passes=None, compile: bool = True,
+                memory_budget=None, _collect=None):
+    """Lint one decode lane (graph + memlint passes; no policy — the
+    decode program is a bf16 serving forward by design)."""
+    passes = tuple(p for p in (passes or GRAPH_PASSES + MEMLINT_PASSES)
+                   if p != "policy")
+    if not passes:
+        # e.g. --passes policy: nothing applies to a decode lane —
+        # skip before paying the build + XLA compilation
+        return analysis.Report()
+    batch, prefill, new_tokens = DECODE_LANES[lane]
+    fn, args, kwargs = build_decode_step(batch, prefill, new_tokens)
+    lowered = fn.lower(*args, **kwargs)
+    ctx = analysis.build_context(lowered, compile=compile)
+    options = {"collectives": {"budget": {"total": 0}}}
+    options.update(_memlint_options(memory_budget))
+    report = analysis.run_passes(ctx, passes=passes, options=options)
+    if _collect is not None:
+        _collect[lane] = _lane_record(ctx, report)
+    return report
+
+
+def multichip_slice_table(n_devices: int = 8) -> dict:
+    """Static per-device HBM of each ``dryrun_multichip`` slice: build
+    and lower+compile every slice on the virtual CPU mesh (nothing
+    executes) and read XLA's memory analysis — the
+    ``hbm_bytes_per_device`` column of ``MULTICHIP_SLICES.json``,
+    derived from analysis instead of hand-waving.  A slice that cannot
+    build/compile on this jax version records its error and moves on,
+    exactly like the dryrun itself."""
+    import __graft_entry__ as graft
+
+    devices = jax.devices("cpu")[:n_devices]
+    if len(devices) < n_devices:
+        # same hazard __graft_entry__._dryrun_impl guards: if another
+        # caller initialized jax's backends before this module's
+        # XLA_FLAGS append, the virtual mesh is missing and every
+        # per-device number would be silently wrong — fail, never
+        # commit wrong gate memory under an "n_devices": 8 header
+        raise RuntimeError(
+            f"need {n_devices} CPU devices for the multichip slice "
+            f"table, have {len(devices)}; jax's backends initialized "
+            f"before xla_force_host_platform_device_count could take "
+            f"effect — run tools/graph_lint.py as the entry point")
+    out = {}
+    for name, build in graft.SLICE_BUILDERS:
+        try:
+            step, args, _check = build(devices)
+            compiled = step.lower(*args).compile()
+            stats = memory_mod.per_device_stats(compiled)
+            rec = {"ok": True}
+            if stats:
+                rec["hbm_bytes_per_device"] = stats["peak_hbm_bytes"]
+                rec["breakdown"] = {k: v for k, v in stats.items()
+                                    if k != "peak_hbm_bytes"}
+            out[name] = rec
+        except Exception as e:  # noqa: BLE001 - per-slice isolation
+            out[name] = {"ok": False,
+                         "error": f"{type(e).__name__}: {e}"[:200]}
+    return out
+
+
+def _calibration_audit() -> "list":
+    """Gate-calibration findings: committed KERNELBENCH/BENCH floors
+    and measurements vs the cost-model ceilings.  An unimportable
+    floor table degrades to a WARNING finding in the artifact — the
+    audit keeps running, but never silently narrows to a clean
+    verdict with the floor half of the check off."""
+    from apex_tpu.analysis.report import Finding
+
+    repo = str(Path(__file__).resolve().parents[1])
+    kernel_floors = mfu_floors = None
+    skipped = []
+    try:
+        import kernel_bench
+        kernel_floors = kernel_bench.KERNEL_FLOORS
+    except Exception as e:  # noqa: BLE001 - audit degrades, never crashes
+        skipped.append(f"kernel_bench.KERNEL_FLOORS ({e})")
+    try:
+        import bench
+        mfu_floors = bench.MFU_FLOORS
+    except Exception as e:  # noqa: BLE001
+        skipped.append(f"bench.MFU_FLOORS ({e})")
+    out = cost_mod.audit_floor_artifacts(repo,
+                                         kernel_floors=kernel_floors,
+                                         mfu_floors=mfu_floors)
+    for what in skipped:
+        out.append(Finding(
+            "cost", "warning",
+            f"floor table unimportable — {what}; published floors NOT "
+            f"audited this round", op="roofline"))
+    return out
+
+
+def emit_memlint(path: str, families, memory_budget=None,
+                 verbose: bool = False) -> int:
+    """Write the MEMLINT artifact: every family's O1+O2 train lanes,
+    the decode lanes, the multichip slice table, and the calibration
+    audit.  Returns the number of error findings across all lanes."""
+    lanes: dict = {}
+    n_errors = 0
+    for family in families:
+        raw = policy_audit.RAW_CASES[family]()   # one build, two lanes
+        for opt_level in ("O1", "O2"):
+            rep = lint_family(family, compile=True, opt_level=opt_level,
+                              memory_budget=memory_budget,
+                              raw=raw, _collect=lanes)
+            n_errors += len(rep.errors)
+            if verbose:
+                print(f"--- {family} {opt_level} ---\n{rep.format()}",
+                      file=sys.stderr)
+    for lane in DECODE_LANES:
+        rep = lint_decode(lane, memory_budget=memory_budget,
+                          _collect=lanes)
+        n_errors += len(rep.errors)
+        if verbose:
+            print(f"--- {lane} ---\n{rep.format()}", file=sys.stderr)
+
+    calibration = _calibration_audit()
+    n_errors += sum(1 for f in calibration if f.severity == "error")
+
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    doc = {
+        "round": int(m.group(1)) if m else 0,
+        "platform": jax.devices()[0].platform,
+        "budget_bytes": int(memory_budget) if memory_budget else None,
+        "lanes": lanes,
+        "multichip": {"n_devices": 8,
+                      "slices": multichip_slice_table(8)},
+        "calibration": {
+            "ok": not any(f.severity == "error" for f in calibration),
+            "findings": [f.to_dict() for f in calibration]},
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"memlint artifact written: {path} ({len(lanes)} lanes)",
+          file=sys.stderr)
+    return n_errors
+
+
+def parse_bytes(text: str) -> int:
+    """``"16GiB"`` / ``"512MiB"`` / ``"1048576"`` -> bytes."""
+    m = re.fullmatch(r"\s*([0-9.]+)\s*([KMG]i?B)?\s*", text)
+    if not m:
+        raise ValueError(f"unparsable byte size {text!r}")
+    mult = {None: 1, "KB": 10**3, "MB": 10**6, "GB": 10**9,
+            "KiB": 1 << 10, "MiB": 1 << 20, "GiB": 1 << 30}[m.group(2)]
+    return int(float(m.group(1)) * mult)
 
 
 def main(argv=None) -> int:
@@ -102,36 +366,130 @@ def main(argv=None) -> int:
                     help=f"comma list from {FAMILIES}")
     ap.add_argument("--passes", default=",".join(ALL_PASSES),
                     help=f"comma list from {ALL_PASSES}")
+    ap.add_argument("--lanes", default="o1,decode",
+                    help="comma list from o1,o2,decode (train opt "
+                         "levels + the decode lanes)")
     ap.add_argument("--no-compile", action="store_true",
                     help="lower only (donation falls back to lowering-"
-                         "time aliasing; sharding/collectives passes "
-                         "report themselves skipped)")
+                         "time aliasing; sharding/collectives/memory/"
+                         "cost passes report themselves skipped)")
+    ap.add_argument("--memory-budget", nargs="?", default=None,
+                    const=str(memory_mod.V5E_HBM_BYTES),
+                    metavar="BYTES",
+                    help="arm the per-device peak-HBM gate (bare flag "
+                         "= v5e 16 GiB; 512MiB / 2GiB forms accepted)")
+    ap.add_argument("--emit-json", default=None, metavar="MEMLINT_rN.json",
+                    help="run ALL lanes (O1+O2 train, decode, multichip"
+                         " slices, calibration audit) and write the "
+                         "memory-lint artifact")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print every finding, not just errors")
     opts = ap.parse_args(argv)
 
     families = [f.strip() for f in opts.families.split(",") if f.strip()]
     passes = tuple(p.strip() for p in opts.passes.split(",") if p.strip())
+    lanes = [x.strip().lower() for x in opts.lanes.split(",") if x.strip()]
     unknown = [f for f in families if f not in FAMILIES]
     if unknown:
         ap.error(f"unknown families {unknown}; have {FAMILIES}")
+    bad_lanes = [x for x in lanes if x not in ("o1", "o2", "decode")]
+    if bad_lanes or not lanes:
+        ap.error(f"unknown lanes {bad_lanes or opts.lanes!r}; have "
+                 f"o1, o2, decode — a typo'd lane list must not pass "
+                 f"the gate by linting nothing")
+    try:
+        budget = parse_bytes(opts.memory_budget) \
+            if opts.memory_budget is not None else None
+    except ValueError as e:
+        ap.error(str(e))
+    if budget is not None and opts.no_compile:
+        ap.error("--memory-budget needs the compiled executable's "
+                 "memory analysis; it cannot combine with "
+                 "--no-compile (an armed budget that asserts nothing "
+                 "must not pass the gate)")
+
+    if opts.emit_json:
+        # the artifact's contract is the FULL matrix (all passes, every
+        # lane, compiled evidence) — silently honoring a restricted
+        # --passes or --no-compile would commit a partial document
+        # under the full schema
+        if opts.no_compile:
+            ap.error("--emit-json needs compiled evidence (memory/"
+                     "cost tables); it cannot combine with "
+                     "--no-compile")
+        if passes != ALL_PASSES:
+            ap.error("--emit-json always runs the full pass matrix; "
+                     "drop --passes (restricted lint is the "
+                     "per-lane mode)")
+        if tuple(families) != FAMILIES:
+            ap.error("--emit-json covers every model family; drop "
+                     "--families (a partial lane set would commit a "
+                     "schema-valid artifact with most of the HBM "
+                     "story silently missing)")
+        if lanes != ["o1", "decode"]:
+            ap.error("--emit-json always writes every lane (O1+O2 "
+                     "train, decode, multichip); drop --lanes")
+        if budget is None:
+            # the artifact's whole point is the asserted per-device
+            # budget — a regeneration that forgot --memory-budget
+            # must not quietly replace a gated round with an
+            # unarmed one
+            budget = memory_mod.V5E_HBM_BYTES
+        n_errors = emit_memlint(opts.emit_json, families,
+                                memory_budget=budget,
+                                verbose=opts.verbose)
+        if n_errors:
+            print(f"graph lint FAILED: {n_errors} error finding(s) — "
+                  f"see the artifact", file=sys.stderr)
+            return 1
+        return 0
 
     failed = []
-    for family in families:
-        report = lint_family(family, passes=passes,
-                             compile=not opts.no_compile)
-        print(json.dumps({"family": family, **report.to_dict()}))
+    linted = []
+
+    def run(label, fn):
+        report = fn()
+        if not report.passes:
+            # e.g. --passes policy on a decode lane: the requested
+            # pass set legitimately doesn't apply — SKIP the lane
+            # (no "ok" line for a program nothing looked at); the
+            # no-lane-linted-anything check below still fails the run
+            # where EVERY lane skips
+            print(f"--- {label} --- skipped: no requested pass "
+                  f"applies to this lane", file=sys.stderr)
+            return
+        linted.append(label)
+        print(json.dumps({"lane": label, **report.to_dict()}))
         if not report.ok:
-            failed.append(family)
-            print(f"--- {family} ---\n{report.format()}", file=sys.stderr)
+            failed.append(label)
+            print(f"--- {label} ---\n{report.format()}", file=sys.stderr)
         elif opts.verbose:
-            print(f"--- {family} ---\n{report.format()}", file=sys.stderr)
+            print(f"--- {label} ---\n{report.format()}", file=sys.stderr)
+
+    for family in families:
+        for opt_level in ("O1", "O2"):
+            if opt_level.lower() not in lanes:
+                continue
+            run(f"{family}_{opt_level.lower()}",
+                lambda f=family, o=opt_level: lint_family(
+                    f, passes=passes, compile=not opts.no_compile,
+                    opt_level=o, memory_budget=budget))
+    if "decode" in lanes:
+        for lane in DECODE_LANES:
+            run(lane, lambda ln=lane: lint_decode(
+                ln, passes=passes, compile=not opts.no_compile,
+                memory_budget=budget))
     if failed:
         print(f"graph lint FAILED for: {failed}", file=sys.stderr)
         return 1
-    print(f"graph lint: all families OK "
-          f"({', '.join(families)}; passes: {', '.join(passes)})",
-          file=sys.stderr)
+    if not linted:
+        print("graph lint FAILED: no requested pass applied to ANY "
+              "selected lane (ran zero passes) — linting nothing "
+              "must not pass the gate", file=sys.stderr)
+        return 1
+    print(f"graph lint: all lanes OK "
+          f"({', '.join(families)}; lanes: {', '.join(lanes)}; "
+          f"passes: {', '.join(passes)})", file=sys.stderr)
     return 0
 
 
