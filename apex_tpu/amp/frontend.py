@@ -121,11 +121,7 @@ class Amp:
             lambda path, x: clone(x, self._cast_leaf_dtype(path)), params)
 
     def _use_master_weights(self) -> bool:
-        p = self.properties
-        if p.master_weights is not None:
-            return bool(p.master_weights)
-        # O1 leaves params fp32: the "masters" are the params themselves.
-        return p.cast_model_dtype is not None and p.cast_model_dtype != jnp.float32
+        return self.properties.use_master_weights
 
     def _cast_leaf_dtype(self, path) -> Any:
         p = self.properties

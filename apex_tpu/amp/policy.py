@@ -114,6 +114,19 @@ class Properties:
     def is_dynamic_loss_scale(self) -> bool:
         return self.loss_scale == DYNAMIC
 
+    @property
+    def use_master_weights(self) -> bool:
+        """Whether fp32 master params are resolved ON under this policy
+        — the single source of truth shared by the runtime
+        (``frontend.Amp``) and the precision lint
+        (:mod:`apex_tpu.analysis.precision`), so the lint's notion of
+        "masters on" can never drift from the runtime's."""
+        if self.master_weights is not None:
+            return bool(self.master_weights)
+        # O1 leaves params fp32: the "masters" are the params themselves.
+        return self.cast_model_dtype is not None \
+            and self.cast_model_dtype != jnp.float32
+
     def replace(self, **kw) -> "Properties":
         return dataclasses.replace(self, **kw)
 

@@ -29,6 +29,11 @@ pass                 catches
 ``syncs``            host callbacks / infeed / outfeed on the step
                      path, retrace hazards, in-place buffers read
                      after dispatch (:mod:`apex_tpu.analysis.syncs`)
+``precision``        the mixed-precision contract op-by-op: forced
+                     sub-f32 matmul accumulation, long 16-bit
+                     reductions, f32→16→f32 double rounds, non-f32
+                     master weights/moments, loss-scale placement
+                     (:mod:`apex_tpu.analysis.precision`)
 ===================  ====================================================
 
 :func:`analyze` lowers (and by default compiles) a jittable function on
@@ -119,6 +124,12 @@ class PassContext:
     #: ``(position_label, type_name, repr)`` of statically-bound
     #: example args (positional index like ``"arg2"`` or the kwarg name)
     static_scalars: Tuple[Tuple[str, str, str], ...] = ()
+    #: the resolved mixed-precision policy the program was built under
+    #: (:class:`apex_tpu.amp.policy.Properties`), when the caller knows
+    #: it — the precision pass reads opt level / half dtype / master-
+    #: weight intent from here; ``None`` degrades it to policy-free
+    #: dtype checks.
+    policy: Optional[Any] = None
     #: derived-table memo (alias set, kept-index map, donation table)
     #: shared across passes — every derived table is a pure function of
     #: one lowering's text, so it is parsed once per context, not once
@@ -284,17 +295,19 @@ def run_passes(ctx: PassContext,
 
 
 def build_context(lowered, compile: bool = True,
-                  static_scalars=()) -> PassContext:
+                  static_scalars=(), policy=None) -> PassContext:
     """One :class:`PassContext` from one lowering: the lowered text,
     the arg/output tables, and (when ``compile``) the compiled
     executable plus its HLO text — shared by every pass so a mixed
-    pass list never lowers or compiles twice."""
+    pass list never lowers or compiles twice.  ``policy`` (the resolved
+    ``amp.policy.Properties``) rides along for the precision pass."""
     compiled = lowered.compile() if compile else None
     return PassContext(
         stablehlo_text=lowered.as_text(),
         hlo_text=compiled.as_text() if compiled is not None else None,
         args=_args_info(lowered), outputs=_out_info(lowered),
-        compiled=compiled, static_scalars=tuple(static_scalars))
+        compiled=compiled, static_scalars=tuple(static_scalars),
+        policy=policy)
 
 
 def lower_quiet(jitted, *args, **kwargs):
@@ -312,9 +325,10 @@ def lower_quiet(jitted, *args, **kwargs):
 def analyze_lowered(lowered,
                     passes: Optional[Sequence[str]] = None,
                     compile: bool = True,
-                    options: Optional[Mapping] = None) -> Report:
+                    options: Optional[Mapping] = None,
+                    policy=None) -> Report:
     """Run lint passes over an already-``.lower()``-ed program."""
-    ctx = build_context(lowered, compile=compile)
+    ctx = build_context(lowered, compile=compile, policy=policy)
     return run_passes(ctx, passes=passes, options=options)
 
 
@@ -323,6 +337,7 @@ def analyze(fn: Callable, *args,
             compile: bool = True,
             donate_argnums=(),
             options: Optional[Mapping] = None,
+            policy=None,
             **kwargs) -> Report:
     """Lower (and compile) ``fn`` on example ``args`` and lint it.
 
@@ -346,6 +361,6 @@ def analyze(fn: Callable, *args,
         jax.jit(fn, donate_argnums=donate_argnums)
     lowered = lower_quiet(jitted, *args, **kwargs)
     ctx = build_context(
-        lowered, compile=compile,
+        lowered, compile=compile, policy=policy,
         static_scalars=_static_scalars(args, kwargs, lowered.args_info))
     return run_passes(ctx, passes=passes, options=options)

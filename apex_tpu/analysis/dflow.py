@@ -1,0 +1,246 @@
+"""Dtype-dataflow walker over pre-optimization StableHLO text.
+
+The precision pass (:mod:`apex_tpu.analysis.precision`) needs more than
+the per-line opcode scan the policy audit uses: it must follow VALUES —
+"the loss-scale argument, broadcast and negated, multiplies the
+backward cotangent; the gradients it taints are cleared by a multiply
+with the reciprocal before they reach the optimizer update".  This
+module is the shared SSA machinery for that: a pragmatic, line-based
+parser of the lowered module into per-function op lists with
+
+- result / operand value tokens (``%33``, ``%33#17``, ``%iterArg_4``),
+- every ``tensor<...>`` type payload on the line, in order,
+- region tracking: ``while``/``case``/generic-``reduce`` bodies are
+  attributed to their owning op, ``stablehlo.return`` operand lists are
+  collected per owner (per-branch for ``case``), and ``while`` header
+  bindings (``%iterArg_k = %value``) are recorded as aliases,
+- per-function use counts (who consumes each value).
+
+It is a FORWARD, single-pass view: loop-carried dataflow is resolved
+through the header bindings only (no fixed point), and values passed
+into private functions are opaque — a caller-visible class can enter a
+``call`` but cannot be transformed inside it.  That is conservative in
+the direction the precision pass needs (taint can only be cleared by
+ops the walker actually sees; see ``precision.py`` for the rules), and
+it keeps the walk O(lines) on the multi-thousand-line lowerings the
+lanes produce.
+
+The parse is deliberately text-anchored (the same stance as
+``analysis/policy.py``): pre-optimization StableHLO is the program the
+user asked for, printed identically across backends, so the walker's
+findings cannot be hidden by a backend that legalizes 16-bit math to
+fp32 internally (XLA:CPU does).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+_TENSOR = re.compile(r"tensor<([^<>]*)>")
+_FUNC = re.compile(
+    r"func\.func\s+(?:public\s+|private\s+)?@([\w$.-]+)\s*\((.*)$")
+_ARG = re.compile(r"(%\w+):\s*tensor<([^<>]*)>")
+_RESULT_INFO = re.compile(r'jax\.result_info\s*=\s*"([^"]*)"')
+_OP = re.compile(
+    r"^\s*(?:(%\w+)(?::(\d+))?\s*=\s*)?"
+    r"\"?((?:stablehlo|chlo|mhlo|func)\.[\w]+|call|return)\b\"?")
+_VALUE = re.compile(r"%[\w]+(?:#\d+)?")
+_BIND = re.compile(r"(%\w+)\s*=\s*(%[\w]+(?:#\d+)?)")
+_DIMS = re.compile(r"across dimensions = \[([0-9, ]*)\]")
+
+
+def element_type(payload: str) -> str:
+    """``"4x32xbf16"`` -> ``"bf16"``; ``"f32"`` -> ``"f32"``."""
+    return payload.split("x")[-1].strip()
+
+
+def dims_of(payload: str) -> Tuple[int, ...]:
+    """Leading integer dims of a tensor payload (``?`` dims skipped)."""
+    out = []
+    for part in payload.split("x")[:-1]:
+        try:
+            out.append(int(part))
+        except ValueError:
+            pass
+    return tuple(out)
+
+
+def base_token(token: str) -> str:
+    """``"%33#17"`` -> ``"%33"``."""
+    return token.split("#", 1)[0]
+
+
+@dataclasses.dataclass
+class Op:
+    """One operation line of a function body."""
+
+    lineno: int
+    line: str
+    name: str                        # short opcode ("dot_general", ...)
+    result: Optional[str]            # base result token ("%33")
+    n_results: int
+    operands: Tuple[str, ...]        # value tokens as written
+    types: Tuple[str, ...]           # tensor<> payloads, line order
+    depth: int                       # region nesting inside the body
+    #: ``stablehlo.return`` operand lists of regions this op owns
+    region_returns: List[Tuple[str, ...]] = dataclasses.field(
+        default_factory=list)
+
+    @property
+    def result_type(self) -> Optional[str]:
+        return self.types[-1] if self.types else None
+
+    @property
+    def result_elem(self) -> Optional[str]:
+        t = self.result_type
+        return element_type(t) if t else None
+
+    def operand_elems(self) -> Tuple[str, ...]:
+        """Element types of the value operands: with a full signature on
+        the line the leading payloads are the operand types; a
+        single-payload (elementwise) line means operands and result all
+        share it."""
+        if len(self.types) >= 2:
+            return tuple(element_type(t) for t in self.types[:-1])
+        if self.types:
+            return (element_type(self.types[0]),) * max(len(self.operands), 1)
+        return ()
+
+    def reduce_dims(self) -> Tuple[int, ...]:
+        m = _DIMS.search(self.line)
+        if not m or not self.types:
+            return ()
+        shape = dims_of(self.types[0])
+        out = []
+        for tok in m.group(1).split(","):
+            tok = tok.strip()
+            if tok.isdigit() and int(tok) < len(shape):
+                out.append(shape[int(tok)])
+        return tuple(out)
+
+    def reduced_elems(self) -> int:
+        """Number of elements folded into each output element."""
+        d = self.reduce_dims()
+        return int(math.prod(d)) if d else 1
+
+
+@dataclasses.dataclass
+class FuncDef:
+    """One ``func.func`` of the lowered module."""
+
+    name: str
+    lineno: int
+    args: List[Tuple[str, str]]          # (token, tensor payload)
+    result_info: List[str]               # jax.result_info strings
+    ops: List[Op] = dataclasses.field(default_factory=list)
+    returns: List[Op] = dataclasses.field(default_factory=list)
+    #: ``%iterArg_k`` -> bound value token (while header bindings)
+    aliases: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: base token -> number of operand uses across the body
+    use_count: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #: base token -> ops consuming it
+    consumers: Dict[str, List[Op]] = dataclasses.field(default_factory=dict)
+
+    def resolve(self, token: str) -> str:
+        """Follow while-header aliases to the bound value's base token."""
+        seen = set()
+        tok = base_token(token)
+        while tok in self.aliases and tok not in seen:
+            seen.add(tok)
+            tok = base_token(self.aliases[tok])
+        return tok
+
+
+#: ops whose single line opens a region body on the following lines
+_REGION_HINTS = ("while", "case", "if", "reduce", "sort", "scatter",
+                 "reduce_window", "map")
+
+
+def parse_module(text: str) -> Dict[str, FuncDef]:
+    """Parse the lowered module text into ``{func_name: FuncDef}``."""
+    funcs: Dict[str, FuncDef] = {}
+    cur: Optional[FuncDef] = None
+    depth = 0                      # brace depth inside the current func
+    region_stack: List[Tuple[Op, int]] = []
+    last_op: Optional[Op] = None
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if cur is None:
+            fm = _FUNC.search(line)
+            if fm:
+                cur = FuncDef(
+                    name=fm.group(1), lineno=lineno,
+                    args=_ARG.findall(line),
+                    result_info=_RESULT_INFO.findall(line))
+                funcs[cur.name] = cur
+                depth = 1
+                region_stack = []
+                last_op = None
+            continue
+
+        opens = line.count("{")
+        closes = line.count("}")
+        om = _OP.search(line)
+        op = None
+        if om:
+            result = om.group(1)
+            n_results = int(om.group(2)) if om.group(2) else 1
+            name = om.group(3).split(".")[-1]
+            tail = line
+            if result is not None:
+                tail = line.split("=", 1)[1]
+            binds = [] if result is None else _BIND.findall(tail)
+            if name == "while" and binds:
+                operands = tuple(v for _k, v in binds)
+                for k, v in binds:
+                    cur.aliases[k] = v
+            else:
+                # strip the attribute/type tail: tokens to the left of
+                # the first " : " are the value operands (type payloads
+                # never contain %, but dims attrs follow operands)
+                operands = tuple(_VALUE.findall(tail.split(" : ")[0]))
+            op = Op(lineno=lineno, line=line, name=name, result=result,
+                    n_results=n_results, operands=operands,
+                    types=tuple(_TENSOR.findall(line)), depth=depth - 1)
+            if name == "return":
+                if depth == 1 and "stablehlo" not in om.group(3):
+                    cur.returns.append(op)
+                elif region_stack:
+                    region_stack[-1][0].region_returns.append(operands)
+            else:
+                cur.ops.append(op)
+                for tok in operands:
+                    b = base_token(tok)
+                    cur.use_count[b] = cur.use_count.get(b, 0) + 1
+                    cur.consumers.setdefault(b, []).append(op)
+                last_op = op
+
+        if opens > closes:
+            owner = op if (op is not None and op.name in _REGION_HINTS) \
+                else last_op
+            if owner is not None:
+                for _ in range(opens - closes):
+                    region_stack.append((owner, depth))
+        depth += opens - closes
+        while region_stack and depth <= region_stack[-1][1]:
+            owner, _d = region_stack.pop()
+            # region-bodied ops (all_reduce, multi-line case) print the
+            # real type signature on the closing "}) : (...) -> ..."
+            # line — override the attr-dict noise captured from the
+            # header so dtype checks see the op's element types
+            if re.match(r"^\s*\}+\)*\s*:", line):
+                tail_types = _TENSOR.findall(line)
+                if tail_types:
+                    owner.types = tuple(tail_types)
+        if depth <= 0:
+            cur = None
+    return funcs
+
+
+def main_func(funcs: Dict[str, FuncDef]) -> Optional[FuncDef]:
+    if "main" in funcs:
+        return funcs["main"]
+    return next(iter(funcs.values()), None)

@@ -1,0 +1,542 @@
+"""Precision-flow lint: the mixed-precision contract, machine-checked.
+
+The paper's central promise is that O0–O3 are *numerically safe by
+policy*: matmuls may run in 16-bit but accumulate in fp32, long
+reductions and norm statistics stay fp32, master weights and optimizer
+moments stay fp32 under O2, and the dynamic loss scale multiplies the
+loss BEFORE the backward and is divided out BEFORE the update
+(Micikevicius et al., 2018; Kalamkar et al., 2019).  Until this pass,
+none of that was verified statically — a silently wrong cast surfaced
+only as a golden-digest drift or a diverged run.  Every invariant below
+is checked op-by-op on the lowered StableHLO with the resolved
+:class:`~apex_tpu.amp.policy.Properties` in the
+:class:`~apex_tpu.analysis.PassContext`.
+
+Finding ids (the ``op`` field of each :class:`Finding`):
+
+``half-accum-matmul`` (error)
+    A dot/conv whose accumulation is *forced* below fp32: f32 operands
+    with a 16-bit result (an explicit ``preferred_element_type``
+    downcast — the accumulator itself is narrowed), or f16 operands
+    accumulating into f16 (the fp16 hazard the paper's §3.3 exists
+    for).  ``bf16 x bf16 -> bf16`` with DEFAULT precision is CLEAN by
+    design: the MXU always accumulates bf16 dots in fp32 and rounds
+    once on output, so the lowered result dtype understates the
+    accumulator — flagging it would fail every correct O1/O2 program.
+    Info (not error) under O3, the documented "speed of light, unsafe"
+    level.
+``low-precision-reduce`` (error)
+    An add/multiply reduction accumulating in a 16-bit dtype over
+    ``reduce_threshold`` or more elements per output (default 1024).
+    Short 16-bit reduce-adds (a batch-4 bias gradient) lose at most a
+    few ulps and the AD-generated backward legitimately emits them in
+    the wire dtype; LONG accumulations are where bf16's 8-bit mantissa
+    actually destroys information (Kalamkar §3: error grows with n).
+    The threshold is what keeps the real lanes clean while a seeded
+    4096-element bf16 reduce fires.  Info under O3.
+``double-round`` (warning)
+    A ``convert`` f32→16-bit whose every consumer immediately converts
+    back to f32: the value lost mantissa for nothing (a pointless
+    f32→bf16→f32 round-trip on the value path).
+``master-weight-dtype`` (error)
+    With master weights resolved on (O2), a floating ``master_params``
+    or ``opt_state`` input leaf that is not f32 — the optimizer would
+    integrate updates in 16-bit, the exact failure mode fp32 masters
+    exist to prevent.
+``comm-dtype`` (error when configured, warning otherwise)
+    A gradient collective (``all_reduce`` / ``reduce_scatter``) whose
+    element type is not the policy's communication dtype
+    (``comm_dtype=`` option); unconfigured, any collective outside
+    {f32, policy half dtype} is flagged as a warning.
+``unscaled-grad-use`` (error)
+    A value on the loss-scale taint path — multiplied by the scale
+    (directly or as an AD cotangent seed) and never divided back —
+    reaching a program output.  This is the loss-scale placement
+    contract: scale dominates the backward, unscale dominates
+    clip/update; a scaled gradient flowing into the returned state (or
+    a clip factor computed from scaled grads) fires here.
+``loss-scale-unused`` (warning)
+    A live loss-scale input that never multiplies anything: the program
+    unscales (or skips) gradients that were never scaled.
+``loss-scale-unchecked`` (info)
+    The lowered argument list could not be matched to the kept example
+    args (numbering ambiguous), so loss-scale placement was NOT checked
+    — the degradation is surfaced, never silent.
+``precision-summary`` (info)
+    Per-lane counters (scale applications, unscales, dots/reduces/
+    converts/collectives checked) — the PRECLINT artifact's evidence
+    that the pass actually looked.
+
+Scale tracking is a five-class forward dataflow over
+:mod:`apex_tpu.analysis.dflow`'s SSA view — ``N`` plain value, ``C``
+constant-derived, ``S`` scale-derived, ``I`` reciprocal-scale-derived,
+``T`` scaled ("tainted") — with ``multiply(N, S) -> T`` recording a
+scale application and ``multiply(T, I)`` / ``divide(T, S) -> N``
+recording an unscale.  Predicates (``compare``/``is_finite``) drop
+taint: the overflow check READS scaled gradients by design.  Values
+entering private functions are conservatively tainted-if-any-operand-
+tainted; an unscale hidden inside a callee is invisible (documented
+limitation — the in-tree scaler unscales inline in ``main``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+from apex_tpu.analysis.core import PassContext, register_pass
+from apex_tpu.analysis.dflow import (FuncDef, Op, base_token, dims_of,
+                                     element_type, main_func, parse_module)
+from apex_tpu.analysis.report import Finding
+
+_HALF = ("bf16", "f16")
+_FLOAT_PREFIXES = ("f", "bf")
+
+#: value classes of the loss-scale dataflow
+N, C, S, I, T = "n", "c", "s", "i", "t"
+
+_STRUCTURAL = frozenset((
+    "convert", "broadcast_in_dim", "broadcast", "reshape", "transpose",
+    "negate", "slice", "dynamic_slice", "dynamic_update_slice",
+    "concatenate", "pad", "reverse", "abs", "exponential", "log",
+    "sqrt", "rsqrt", "tanh", "logistic", "add", "subtract", "maximum",
+    "minimum", "select", "clamp", "power", "get_tuple_element",
+    "optimization_barrier", "copy", "tuple", "real", "imag",
+))
+_PREDICATES = frozenset((
+    "compare", "is_finite", "and", "or", "not", "xor", "iota",
+    "floor", "ceil", "round_nearest_even", "sign",
+))
+_LOSSY_REDUCERS = ("stablehlo.add", "stablehlo.multiply")
+_GRAD_COLLECTIVES = ("all_reduce", "reduce_scatter")
+
+
+def _half_name(policy) -> str:
+    """Policy half dtype -> StableHLO element spelling ("bf16"/"f16")."""
+    try:
+        import numpy as np  # ml_dtypes registers bfloat16 with numpy
+        name = np.dtype(policy.half_dtype).name
+    except Exception:  # noqa: BLE001 - unresolvable dtype: assume bf16
+        name = "bfloat16"
+    return {"bfloat16": "bf16", "float16": "f16"}.get(name, "bf16")
+
+
+def _is_float(elem: Optional[str]) -> bool:
+    return bool(elem) and elem.startswith(_FLOAT_PREFIXES) \
+        and elem not in ("f8",)
+
+
+def _use_master_weights(policy) -> bool:
+    """The policy's resolved master-weight switch — delegated to
+    :attr:`apex_tpu.amp.policy.Properties.use_master_weights` (the one
+    shared resolution, so lint and runtime can't drift); a foreign
+    policy object without the property falls back to the same rule."""
+    umw = getattr(policy, "use_master_weights", None)
+    if isinstance(umw, bool):
+        return umw
+    if getattr(policy, "master_weights", None) is not None:
+        return bool(policy.master_weights)
+    cast = getattr(policy, "cast_model_dtype", None)
+    if cast is None:
+        return False
+    try:
+        import jax.numpy as jnp
+        return cast != jnp.float32
+    except Exception:  # noqa: BLE001
+        return True
+
+
+# ---------------------------------------------------------------------------
+# scale-placement dataflow
+# ---------------------------------------------------------------------------
+
+def _join(classes) -> str:
+    """S-dominant join: once a value is scale-proportional it stays so
+    through structural/arithmetic composition until something multiplies
+    it into data (-> T) or cancels it (-> C/N)."""
+    cs = set(classes)
+    if T in cs:
+        return T
+    if S in cs:
+        return S
+    if I in cs:
+        return I
+    if cs and cs <= {C}:
+        return C
+    return N
+
+
+class _ScaleFlow:
+    """One forward propagation of the five value classes over a func.
+
+    The *scale application* event — the moment the pure scale chain
+    first multiplies actual data — is recognized in every spelling the
+    lowerings produce: ``multiply(N, S)``, ``divide(N, I)``, a dot/conv
+    with an S operand against data, and an S value entering a private
+    call together with plain float data (AD routes the cotangent seed
+    through ``take_along_axis``/``log_softmax`` helpers)."""
+
+    def __init__(self, func: FuncDef, scale_tokens):
+        self.func = func
+        self.classes: Dict[str, str] = {}
+        self.applied = 0           # scale-application sites
+        self.unscaled = 0          # multiply(T, I) / divide(T, S) sites
+        self.first_taint: Dict[str, Op] = {}
+        for tok, _t in func.args:
+            self.classes[tok] = S if tok in scale_tokens else N
+
+    def cls(self, token: str) -> str:
+        tok = self.func.resolve(token)
+        full = token if "#" in token else tok
+        return self.classes.get(full, self.classes.get(tok, N))
+
+    def _transfer(self, op: Op) -> str:
+        ops_cls = [self.cls(t) for t in op.operands]
+        cs = set(ops_cls)
+        if op.name in ("constant", "iota"):
+            return C
+        if op.name in _PREDICATES:
+            return N
+        if op.name == "multiply":
+            if T in cs and I in cs:
+                self.unscaled += 1
+                return N          # the unscale
+            if T in cs:
+                return T
+            if S in cs and N in cs:
+                self.applied += 1
+                return T          # the scale application
+            if I in cs and N in cs:
+                return N
+            if S in cs and I in cs:
+                return C
+            return _join(ops_cls)
+        if op.name == "divide" and len(ops_cls) >= 2:
+            num, den = ops_cls[0], ops_cls[-1]
+            if num == T and den == S:
+                self.unscaled += 1
+                return N          # unscale spelled as a divide
+            if T in (num, den):
+                return T
+            if den == S:
+                return I if num == C else N
+            if den == I:
+                if num == N:
+                    self.applied += 1
+                    return T      # x / (1/scale) == x * scale
+                return S if num == C else N
+            if num == S:
+                return S          # scale / count: still scale-magnitude
+            if num == I:
+                return I if den == C else N
+            return C if (num, den) == (C, C) else N
+        if op.name in ("dot_general", "dot", "convolution"):
+            if T in cs:
+                return T
+            if S in cs and N in cs:
+                self.applied += 1
+                return T          # cotangent seed contracts with data
+            return _join(ops_cls)
+        if op.name == "call":
+            if T in cs:
+                return T
+            if S in cs:
+                # S mixing with float DATA inside a callee is a scale
+                # application; S alongside only predicates/indices/
+                # other scale values (the scaler's _where helpers)
+                # stays a pure scale chain
+                elems = op.operand_elems()
+                data_floats = any(
+                    c == N and k < len(elems) and _is_float(elems[k])
+                    for k, c in enumerate(ops_cls))
+                if data_floats:
+                    self.applied += 1
+                    return T
+                return S
+            return _join(ops_cls)
+        if op.name in ("reduce",) or op.name in _STRUCTURAL:
+            return _join(ops_cls)
+        if op.name in ("while", "case", "if"):
+            return _join(ops_cls)  # refined per-index by the sweep
+        return T if T in ops_cls else N
+
+    def _set(self, op: Op, cls) -> bool:
+        """Assign (possibly per-index) classes; True when changed."""
+        changed = False
+        keys = [op.result]
+        if op.n_results > 1:
+            keys += [f"{op.result}#{k}" for k in range(op.n_results)]
+        if isinstance(cls, str):
+            cls = {k: cls for k in keys}
+        for k in keys:
+            v = cls.get(k, cls.get(op.result, N))
+            if self.classes.get(k) != v:
+                self.classes[k] = v
+                changed = True
+                if v == T and op.result not in self.first_taint:
+                    self.first_taint[op.result] = op
+        return changed
+
+    def run(self, max_sweeps: int = 8) -> None:
+        for sweep in range(max_sweeps):
+            changed = False
+            self.applied = 0
+            self.unscaled = 0
+            for op in self.func.ops:
+                if op.result is None:
+                    continue
+                if op.name in ("while", "case", "if") and op.region_returns:
+                    per = {}
+                    for k in range(op.n_results):
+                        cands = []
+                        if op.name == "while" and k < len(op.operands):
+                            cands.append(self.cls(op.operands[k]))
+                        for ret in op.region_returns:
+                            if len(ret) == op.n_results:
+                                cands.append(self.cls(ret[k]))
+                        key = f"{op.result}#{k}" if op.n_results > 1 \
+                            else op.result
+                        per[key] = _join(cands) if cands else N
+                    per[op.result] = _join(per.values())
+                    changed |= self._set(op, per)
+                else:
+                    changed |= self._set(op, self._transfer(op))
+            if not changed:
+                break
+
+
+# ---------------------------------------------------------------------------
+# the pass
+# ---------------------------------------------------------------------------
+
+def precision_report(ctx: PassContext, policy: Any = None,
+                     reduce_threshold: int = 1024,
+                     double_round_min_elems: int = 256,
+                     comm_dtype: Optional[str] = None,
+                     ) -> Tuple[List[Finding], Dict[str, Any]]:
+    """Run every precision check; returns ``(findings, stats)``.
+
+    ``policy`` overrides ``ctx.policy``; with neither, the dtype checks
+    run with bf16 defaults and the policy-gated checks (master-weight,
+    O3 demotion) degrade conservatively.
+    """
+    policy = policy if policy is not None else getattr(ctx, "policy", None)
+    half = _half_name(policy) if policy is not None else "bf16"
+    opt_level = getattr(policy, "opt_level", None)
+    enabled = getattr(policy, "enabled", True)
+    #: O3 opted out of the safety contract: dtype findings demote to info
+    strict = opt_level in (None, "O0", "O1", "O2")
+    findings: List[Finding] = []
+    stats = {"dots": 0, "reduces": 0, "converts": 0, "collectives": 0,
+             "scale_args": 0, "scale_applied": 0, "unscaled": 0}
+
+    funcs = ctx.memo("dflow",
+                     lambda: parse_module(ctx.stablehlo_text))
+    main = main_func(funcs)
+    if main is None:
+        return [Finding("precision", "info",
+                        "no function found in the lowered module; "
+                        "precision pass saw nothing", op="precision-summary")
+                ], stats
+
+    def_map: Dict[str, Op] = {}
+    for fn in funcs.values():
+        for op in fn.ops:
+            if op.result is not None:
+                def_map.setdefault(op.result, op)
+
+    # -- per-op dtype checks over every function ------------------------
+    for fn in funcs.values():
+        # returned values (func returns + every region's returns) are
+        # real uses the consumer table doesn't record: a 16-bit value
+        # leaving the function/region was not converted "for nothing"
+        returned = {base_token(t) for ret in fn.returns
+                    for t in ret.operands}
+        for o in fn.ops:
+            for rr in o.region_returns:
+                returned.update(base_token(t) for t in rr)
+        for op in fn.ops:
+            if op.name in ("dot_general", "dot", "convolution"):
+                elems = [e for e in op.operand_elems() if _is_float(e)]
+                re_ = op.result_elem
+                if not elems or not _is_float(re_):
+                    continue
+                stats["dots"] += 1
+                if re_ in _HALF and any(e == "f32" for e in elems):
+                    findings.append(Finding(
+                        "precision", "error" if strict else "info",
+                        f"{op.name} accumulates f32 operands into {re_} "
+                        f"(preferred_element_type narrows the "
+                        f"accumulator below the operands)",
+                        op="half-accum-matmul", dtype=re_,
+                        lineno=op.lineno, example=op.line.strip()[:200]))
+                elif re_ == "f16" and all(e == "f16" for e in elems):
+                    findings.append(Finding(
+                        "precision", "error" if strict else "info",
+                        f"{op.name} accumulates in f16 — fp16 dots must "
+                        f"request f32 accumulation "
+                        f"(preferred_element_type=float32); bf16 is "
+                        f"exempt only because the MXU accumulates it in "
+                        f"f32 by hardware contract",
+                        op="half-accum-matmul", dtype="f16",
+                        lineno=op.lineno, example=op.line.strip()[:200]))
+            elif op.name == "reduce":
+                acc = op.result_elem
+                if not _is_float(acc):
+                    continue
+                lossy = any(r in op.line for r in _LOSSY_REDUCERS)
+                if not lossy and "applies" not in op.line:
+                    # generic-form reduce: the reducer region's returned
+                    # value names the combining op
+                    for ret in op.region_returns:
+                        d = def_map.get(base_token(ret[0])) if ret else None
+                        if d is not None and d.name in ("add", "multiply"):
+                            lossy = True
+                if not lossy:
+                    continue
+                stats["reduces"] += 1
+                n = op.reduced_elems()
+                if acc in _HALF and n >= reduce_threshold:
+                    findings.append(Finding(
+                        "precision", "error" if strict else "info",
+                        f"reduce accumulates {n} elements per output in "
+                        f"{acc}; accumulations this long must run in "
+                        f"f32 (jnp.sum/mean upcast automatically — raw "
+                        f"lax.reduce does not)",
+                        op="low-precision-reduce", dtype=acc,
+                        count=n, lineno=op.lineno,
+                        example=op.line.strip()[:200]))
+            elif op.name == "convert":
+                in_e = op.operand_elems()[:1]
+                re_ = op.result_elem
+                if in_e and in_e[0] == "f32" and re_ in _HALF \
+                        and op.result is not None:
+                    stats["converts"] += 1
+                    elems = int(math.prod(dims_of(op.result_type))) \
+                        if op.result_type else 0
+                    users = fn.consumers.get(op.result, [])
+                    if strict and elems >= double_round_min_elems \
+                            and op.result not in returned \
+                            and users and all(
+                            u.name == "convert" and u.result_elem == "f32"
+                            for u in users):
+                        findings.append(Finding(
+                            "precision", "warning",
+                            f"f32→{re_}→f32 double-round over {elems} "
+                            f"elements: the {re_} value is only ever "
+                            f"converted straight back (mantissa lost "
+                            f"for nothing)",
+                            op="double-round", dtype=re_, count=elems,
+                            lineno=op.lineno,
+                            example=op.line.strip()[:200]))
+            elif op.name in _GRAD_COLLECTIVES:
+                elem = op.result_elem
+                if not _is_float(elem):
+                    continue
+                stats["collectives"] += 1
+                # O3's opt-out demotes comm-dtype like every other
+                # dtype finding (the documented contract)
+                if comm_dtype is not None:
+                    if elem != comm_dtype:
+                        findings.append(Finding(
+                            "precision", "error" if strict else "info",
+                            f"gradient {op.name} runs at {elem}; the "
+                            f"policy's communication dtype is "
+                            f"{comm_dtype}",
+                            op="comm-dtype", dtype=elem,
+                            lineno=op.lineno,
+                            example=op.line.strip()[:200]))
+                elif elem not in ("f32", half):
+                    findings.append(Finding(
+                        "precision", "warning" if strict else "info",
+                        f"gradient {op.name} runs at {elem} — neither "
+                        f"f32 nor the policy half dtype ({half}); pass "
+                        f"comm_dtype= to pin the contract",
+                        op="comm-dtype", dtype=elem, lineno=op.lineno,
+                        example=op.line.strip()[:200]))
+
+    # -- master-weight / moment dtypes (argument table) ------------------
+    if policy is not None and enabled and _use_master_weights(policy):
+        for a in ctx.args:
+            # matches both NamedTuple (".master_params") and plain-dict
+            # ("['master_params']") state spellings
+            leaf_kind = None
+            if "master_params" in a.path:
+                leaf_kind = "master weight"
+            elif "opt_state" in a.path:
+                leaf_kind = "optimizer moment"
+            if leaf_kind is None:
+                continue
+            if a.dtype.startswith(("float", "bfloat")) \
+                    and a.dtype != "float32":
+                findings.append(Finding(
+                    "precision", "error",
+                    f"{leaf_kind} {a.path} is {a.dtype}; with master "
+                    f"weights on ({opt_level}) it must be float32 — a "
+                    f"16-bit master integrates updates below the "
+                    f"representable step size",
+                    op="master-weight-dtype", dtype=a.dtype,
+                    bytes=a.nbytes))
+
+    # -- loss-scale placement -------------------------------------------
+    scale_tokens = set()
+    kept = ctx.kept_args
+    if kept and len(main.args) == len(kept):
+        for k, a in enumerate(kept):
+            if "loss_scale" in a.path and a.dtype == "float32":
+                scale_tokens.add(main.args[k][0])
+    elif any("loss_scale" in a.path for a in ctx.args):
+        findings.append(Finding(
+            "precision", "info",
+            f"argument numbering ambiguous ({len(main.args)} lowered "
+            f"args vs {len(kept)} kept) — loss-scale placement not "
+            f"checked", op="loss-scale-unchecked"))
+    stats["scale_args"] = len(scale_tokens)
+
+    if scale_tokens:
+        flow = _ScaleFlow(main, scale_tokens)
+        flow.run()
+        stats["scale_applied"] = flow.applied
+        stats["unscaled"] = flow.unscaled
+        if flow.applied == 0:
+            findings.append(Finding(
+                "precision", "warning",
+                "a live loss-scale input never multiplies the loss or "
+                "backward — gradients are unscaled (or skipped) "
+                "without ever having been scaled",
+                op="loss-scale-unused"))
+        info = main.result_info
+        for ret in main.returns:
+            for i, tok in enumerate(ret.operands):
+                if flow.cls(tok) == T:
+                    path = info[i] if i < len(info) else f"output {i}"
+                    seed = flow.first_taint.get(
+                        main.resolve(tok))
+                    findings.append(Finding(
+                        "precision", "error",
+                        f"output {path} is still loss-scaled: the "
+                        f"value was multiplied by the scale and never "
+                        f"divided back before leaving the program "
+                        f"(unscale must dominate every update/output "
+                        f"use of the gradients)",
+                        op="unscaled-grad-use",
+                        lineno=seed.lineno if seed else None))
+
+    findings.append(Finding(
+        "precision", "info",
+        f"checked {stats['dots']} matmul/conv, {stats['reduces']} lossy "
+        f"reduce(s), {stats['converts']} f32→16 convert(s), "
+        f"{stats['collectives']} gradient collective(s); loss scale: "
+        f"{stats['scale_args']} input(s), {stats['scale_applied']} "
+        f"application(s), {stats['unscaled']} unscale(s)",
+        op="precision-summary"))
+    return findings, stats
+
+
+def precision_pass(ctx: PassContext, **options) -> List[Finding]:
+    """Registry entry: :func:`precision_report` without the stats."""
+    findings, _stats = precision_report(ctx, **options)
+    return findings
+
+
+register_pass("precision", precision_pass)

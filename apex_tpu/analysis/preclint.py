@@ -1,0 +1,98 @@
+"""PRECLINT_r*.json — schema for the committed precision-lint artifact.
+
+``tools/graph_lint.py --emit-json PRECLINT_rN.json`` writes one of
+these per round: the precision verdict of every lint lane — all four
+model families at every opt level O0–O3 plus the decode lanes — as
+produced by the precision pass (:mod:`apex_tpu.analysis.precision`).
+Like MEMLINT and the incident records, the artifact is gate memory:
+``tools/gate_hygiene.py`` validates every committed ``PRECLINT_r*.json``
+against this schema so the precision story can't rot into prose nobody
+machine-checks.
+
+This module is deliberately **stdlib-only** (no jax import):
+``gate_hygiene`` loads it directly by file path the same way it loads
+``analysis/memlint.py`` and ``resilience/incidents.py``.
+
+Document shape::
+
+    {
+      "round": 1,
+      "platform": "cpu",            # backend the lanes lowered for
+      "half_dtype": "bfloat16",     # the policies' 16-bit dtype
+      "lanes": {
+        "<lane>": {                 # e.g. "mlp_o1_train", "decode_b1"
+          "ok": true,               # no error-severity finding
+          "findings": {"error": 0, "warning": 0, "info": 1},
+          "checked": {              # the pass's evidence counters
+            "dots": 5, "reduces": 9, "converts": 6,
+            "collectives": 0, "scale_args": 1,
+            "scale_applied": 1, "unscaled": 4
+          }
+        }, ...
+      }
+    }
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+#: counters every lane's ``checked`` table must carry
+_CHECKED_KEYS = ("dots", "reduces", "converts", "collectives",
+                 "scale_args", "scale_applied", "unscaled")
+
+_LANE_REQUIRED = {
+    "ok": lambda v: isinstance(v, bool),
+    "findings": lambda v: isinstance(v, dict) and all(
+        isinstance(n, int) and n >= 0 for n in v.values()),
+    "checked": lambda v: isinstance(v, dict) and all(
+        isinstance(v.get(k), int) and v[k] >= 0 for k in _CHECKED_KEYS),
+}
+
+
+def validate_preclint(doc) -> List[str]:
+    """Problems with one parsed PRECLINT document (empty = valid)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not a JSON object"]
+    if not isinstance(doc.get("round"), int):
+        problems.append("missing/invalid 'round' (int)")
+    if not isinstance(doc.get("platform"), str):
+        problems.append("missing/invalid 'platform' (str)")
+    if not isinstance(doc.get("half_dtype"), str):
+        problems.append("missing/invalid 'half_dtype' (str)")
+    lanes = doc.get("lanes")
+    if not isinstance(lanes, dict) or not lanes:
+        return problems + ["missing/empty 'lanes' object"]
+    for name, lane in lanes.items():
+        if not isinstance(lane, dict):
+            problems.append(f"lane {name!r} is not an object")
+            continue
+        for key, check in _LANE_REQUIRED.items():
+            if key not in lane:
+                problems.append(f"lane {name!r} missing {key!r}")
+            elif not check(lane[key]):
+                problems.append(f"lane {name!r} has invalid {key!r}: "
+                                f"{lane[key]!r}")
+        # a lane claiming ok while recording error findings (or vice
+        # versa) is internally inconsistent — the verdict must be
+        # derivable from the document alone
+        if isinstance(lane.get("findings"), dict) and \
+                isinstance(lane.get("ok"), bool):
+            has_errors = lane["findings"].get("error", 0) > 0
+            if lane["ok"] == has_errors:
+                problems.append(
+                    f"lane {name!r}: ok={lane['ok']} contradicts "
+                    f"findings {lane['findings']}")
+    return problems
+
+
+def validate_preclint_file(path: str) -> List[str]:
+    """Problems with one PRECLINT_r*.json file (empty = valid)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable preclint JSON: {e}"]
+    return validate_preclint(doc)

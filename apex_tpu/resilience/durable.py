@@ -80,12 +80,21 @@ def _flatten_payload(payload: Any) -> List[Tuple[str, np.ndarray]]:
 
 def write_snapshot(directory: str, step: int, payload: Any,
                    fsync: bool = True) -> str:
-    """Stage + atomically commit one snapshot; returns the final path."""
+    """Stage + atomically commit one snapshot; returns the final path.
+
+    A re-save of an existing step never deletes the old snapshot before
+    the new one is committed: the old directory is renamed to an
+    ``.old-*`` sibling, the new one renamed into place, and only then is
+    the aside copy dropped.  A crash in ANY window leaves at least one
+    good copy of the step — under its final name, or under the aside
+    name that :func:`recover_asides` (run by every manager construction)
+    renames back."""
     final = os.path.join(directory, _step_dirname(step))
     tmp = os.path.join(directory,
                        f".tmp-{_step_dirname(step)}-{os.getpid()}-"
                        f"{threading.get_ident()}")
     os.makedirs(tmp)
+    aside = None
     try:
         leaves: Dict[str, Dict[str, Any]] = {}
         for i, (key, arr) in enumerate(_flatten_payload(payload)):
@@ -113,15 +122,53 @@ def write_snapshot(directory: str, step: int, payload: Any,
                 os.fsync(f.fileno())
         if fsync:
             _fsync_dir(tmp)
-        if os.path.exists(final):  # re-save of a step: replace wholesale
-            shutil.rmtree(final)
+        if os.path.exists(final):
+            # re-save of a step: the old snapshot must survive until
+            # the new one is committed.  Rename it aside (atomic),
+            # commit the new directory, then drop the aside copy.
+            aside = os.path.join(
+                directory,
+                f".old-{_step_dirname(step)}-{os.getpid()}-"
+                f"{threading.get_ident()}")
+            if os.path.exists(aside):
+                shutil.rmtree(aside)
+            os.replace(final, aside)
         os.replace(tmp, final)
         if fsync:
             _fsync_dir(directory)
+        if aside is not None:
+            shutil.rmtree(aside, ignore_errors=True)
         return final
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
+        if aside is not None and not os.path.exists(final) \
+                and os.path.isdir(aside):
+            os.replace(aside, final)   # put the old snapshot back
         raise
+
+
+def recover_asides(directory: str) -> List[str]:
+    """Finish re-saves interrupted between the rename-aside and the
+    commit: an ``.old-step_*`` sibling whose ``step_*`` directory is
+    missing IS the last good snapshot of that step — rename it back into
+    place; one whose step directory exists is post-commit garbage and is
+    dropped.  Returns the restored final paths.  Run by every
+    :class:`DurableCheckpointManager` construction, before the
+    ``.tmp-*`` sweep."""
+    restored: List[str] = []
+    for name in sorted(os.listdir(directory)):
+        if not name.startswith(".old-" + _STEP_PREFIX):
+            continue
+        # ".old-step_00000012-<pid>-<tid>" -> "step_00000012"
+        stepdir = name[len(".old-"):].split("-")[0]
+        final = os.path.join(directory, stepdir)
+        aside = os.path.join(directory, name)
+        if os.path.isdir(final):
+            shutil.rmtree(aside, ignore_errors=True)
+        else:
+            os.replace(aside, final)
+            restored.append(final)
+    return restored
 
 
 def verify_snapshot(path: str) -> Tuple[bool, List[str]]:
@@ -152,14 +199,21 @@ def verify_snapshot(path: str) -> Tuple[bool, List[str]]:
 
 def read_snapshot(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
     """Load a snapshot, verifying every checksum as it reads — one pass
-    of IO and hashing; ANY malformation (unreadable/alien manifest,
-    missing leaf file, checksum mismatch, unparsable npy) raises
-    :class:`CheckpointCorruptError` so callers have a single
-    this-snapshot-is-bad signal to fall back on."""
+    of IO and hashing.  Malformation of the snapshot ITSELF
+    (unreadable/alien manifest, missing leaf file, checksum mismatch,
+    unparsable npy) raises :class:`CheckpointCorruptError` so callers
+    have a single this-snapshot-is-bad signal to fall back on.  A
+    transient IO failure — any :class:`OSError` other than the file
+    being absent — propagates AS-IS: it says nothing about the snapshot
+    on disk, and wrapping it as corruption would make
+    ``loop.retry_io``-driven restores silently fall back to an older
+    step instead of retrying the flake."""
     try:
         with open(os.path.join(path, MANIFEST)) as f:
             manifest = json.load(f)
-    except (OSError, ValueError) as e:
+    except FileNotFoundError as e:
+        raise CheckpointCorruptError(f"{path}: manifest missing: {e}")
+    except ValueError as e:
         raise CheckpointCorruptError(f"{path}: manifest unreadable: {e}")
     if manifest.get("format") != FORMAT:
         raise CheckpointCorruptError(
@@ -169,9 +223,11 @@ def read_snapshot(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
         try:
             with open(os.path.join(path, meta["file"]), "rb") as f:
                 raw = f.read()
-        except OSError as e:
+        except FileNotFoundError as e:
+            # a leaf named by the manifest but absent on disk IS the
+            # snapshot's structure being broken (truncated commit)
             raise CheckpointCorruptError(
-                f"{path}: {key}: leaf file unreadable: {e}")
+                f"{path}: {key}: leaf file missing: {e}")
         if hashlib.sha256(raw).hexdigest() != meta["sha256"]:
             raise CheckpointCorruptError(
                 f"{path}: {key}: checksum mismatch in {meta['file']} "
@@ -226,7 +282,10 @@ class DurableCheckpointManager:
         self._worker: Optional[threading.Thread] = None
         self._closed = False
         self.last_restore: Optional[Dict[str, Any]] = None
-        # a crash mid-stage leaves .tmp-* siblings; they are dead weight
+        # a crash between a re-save's rename-aside and its commit left
+        # the step's last good snapshot under an .old-* name — restore
+        # it first, THEN sweep the dead-weight .tmp-* staging dirs
+        recover_asides(self._dir)
         for name in os.listdir(self._dir):
             if name.startswith(".tmp-"):
                 shutil.rmtree(os.path.join(self._dir, name),

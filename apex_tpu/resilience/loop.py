@@ -206,7 +206,8 @@ def run_resilient(
     # -- rewind machinery -------------------------------------------------
     rewinds = 0
     consecutive_pinned = 0
-    mem_snapshot: Optional[Tuple[int, Any]] = None  # (step, host payload)
+    # (step, ("amp", ckpt state_dict) | ("tree", host leaf copies))
+    mem_snapshot: Optional[Tuple[int, Any]] = None
 
     def _reinit_scaler(st: Any) -> Any:
         if amp_obj is None or not hasattr(st, "scaler_states"):
@@ -228,7 +229,16 @@ def run_resilient(
                          {"event": "save_retry", "step": step_i,
                           "attempt": a, "error": repr(e)}))
         else:   # managerless runs rewind from a host snapshot instead
-            mem_snapshot = (step_i, ckpt.state_dict(st))
+            if hasattr(st, "master_params"):
+                mem_snapshot = (step_i, ("amp", ckpt.state_dict(st)))
+            else:
+                # run_resilient never required AmpState — a generic
+                # pytree state snapshots as a plain host copy of its
+                # leaves (ckpt.state_dict reads AmpState fields and
+                # would crash here)
+                import jax
+                mem_snapshot = (step_i,
+                                ("tree", jax.tree.map(np.asarray, st)))
         events.append({"event": "checkpoint", "step": step_i})
 
     def _rewind(st: Any, reason: str) -> Tuple[Any, int]:
@@ -257,8 +267,13 @@ def run_resilient(
                 retries=cfg.io_retries, backoff_s=cfg.io_backoff_s)
             restored = manager.last_restore["step"]
         elif mem_snapshot is not None:
-            snap_step, payload = mem_snapshot
-            new_state, _ = ckpt.load_state_dict(st, payload)
+            snap_step, (kind, payload) = mem_snapshot
+            if kind == "amp":
+                new_state, _ = ckpt.load_state_dict(st, payload)
+            else:       # generic-pytree snapshot: host leaves back to jax
+                import jax
+                new_state = jax.tree.map(
+                    lambda s, _r: jax.numpy.asarray(s), payload, st)
             restored = snap_step
         else:
             _write_incident(

@@ -1,0 +1,360 @@
+"""Precision-flow pass coverage (:mod:`apex_tpu.analysis.precision`).
+
+Each finding class must (a) FIRE on a seeded violating program — a
+deliberately bf16-accumulating long reduce, an f16-accumulating dot, a
+dropped master-weight cast, a mis-ordered unscale — with the documented
+finding id, and (b) stay QUIET on the correct spellings and on the real
+model families' O1/O2 train lanes (the continuously-enforced half of
+the paper's "numerically safe by policy" contract; ISSUE 5).  The
+shared dtype-dataflow walker (:mod:`apex_tpu.analysis.dflow`) and the
+PRECLINT artifact schema (:mod:`apex_tpu.analysis.preclint`) are pinned
+here too.
+"""
+
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+REPO = Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+from apex_tpu import amp, analysis  # noqa: E402
+from apex_tpu.analysis import dflow  # noqa: E402
+from apex_tpu.analysis.precision import precision_report  # noqa: E402
+from apex_tpu.analysis.preclint import (validate_preclint,  # noqa: E402
+                                        validate_preclint_file)
+
+
+def _run(fn, *args, policy=None):
+    return analysis.analyze(fn, *args, passes=("precision",),
+                            compile=False, policy=policy)
+
+
+def _ops(report):
+    return [f.op for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# seeded violations fire with the documented finding ids
+# ---------------------------------------------------------------------------
+
+def test_seeded_bf16_long_reduce_fires():
+    """A raw lax.reduce accumulating 4096 elements in bf16 is exactly
+    the Kalamkar §3 failure — jnp.sum would have upcast; the seeded
+    program skips that on purpose."""
+    def f(x):
+        return jax.lax.reduce(x, jnp.bfloat16(0), jax.lax.add, (0,))
+
+    rep = _run(f, jnp.ones((4096,), jnp.bfloat16))
+    errs = [f_ for f_ in rep.findings if f_.op == "low-precision-reduce"]
+    assert len(errs) == 1 and errs[0].severity == "error"
+    assert errs[0].count == 4096 and errs[0].dtype == "bf16"
+
+
+def test_short_bf16_reduce_is_quiet():
+    """Sub-threshold 16-bit reduce-adds (the AD backward emits them for
+    small batch axes) lose a few ulps at most — must not fire."""
+    def f(x):
+        return jax.lax.reduce(x, jnp.bfloat16(0), jax.lax.add, (0,))
+
+    rep = _run(f, jnp.ones((8,), jnp.bfloat16))
+    assert rep.ok and _ops(rep) == ["precision-summary"]
+
+
+def test_f16_accumulating_dot_fires():
+    def f(a, b):
+        return a @ b
+
+    rep = _run(f, jnp.ones((8, 8), jnp.float16), jnp.ones((8, 8), jnp.float16))
+    errs = [f_ for f_ in rep.findings if f_.op == "half-accum-matmul"]
+    assert len(errs) == 1 and errs[0].severity == "error"
+    assert "f32 accumulation" in errs[0].message
+
+
+def test_narrowed_accumulator_dot_fires():
+    """f32 operands with preferred_element_type=bf16: the accumulator
+    itself is narrowed below the operands."""
+    def f(a, b):
+        return jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.bfloat16)
+
+    rep = _run(f, jnp.ones((8, 8)), jnp.ones((8, 8)))
+    assert any(f_.op == "half-accum-matmul" and f_.severity == "error"
+               for f_ in rep.findings)
+
+
+def test_bf16_dot_default_precision_is_clean():
+    """bf16 x bf16 -> bf16 is the CORRECT O1/O2 matmul spelling: the MXU
+    accumulates it in f32 by hardware contract — flagging it would fail
+    every correct program."""
+    def f(a, b):
+        return a @ b
+
+    rep = _run(f, jnp.ones((8, 8), jnp.bfloat16),
+               jnp.ones((8, 8), jnp.bfloat16))
+    assert rep.ok and _ops(rep) == ["precision-summary"]
+
+
+def test_double_round_warns():
+    def f(x):
+        return x.astype(jnp.bfloat16).astype(jnp.float32) + 1.0
+
+    rep = _run(f, jnp.ones((512,), jnp.float32))
+    warns = [f_ for f_ in rep.findings if f_.op == "double-round"]
+    assert len(warns) == 1 and warns[0].severity == "warning"
+    assert warns[0].count == 512
+
+
+def test_returned_bf16_value_is_not_double_round():
+    """A 16-bit value that LEAVES the program is a real use the
+    consumer table doesn't record — an O2 step returning bf16 params
+    alongside an f32-derived metric must not warn."""
+    def f(x):
+        y = x.astype(jnp.bfloat16)
+        return y, y.astype(jnp.float32) + 1.0
+
+    rep = _run(f, jnp.ones((512,), jnp.float32))
+    assert not any(f_.op == "double-round" for f_ in rep.findings)
+
+
+def test_useful_downcast_is_not_double_round():
+    """A bf16 value actually CONSUMED in bf16 (here by a dot) lost its
+    mantissa for a reason — no finding."""
+    def f(x, w):
+        return x.astype(jnp.bfloat16) @ w
+
+    rep = _run(f, jnp.ones((512, 16), jnp.float32),
+               jnp.ones((16, 4), jnp.bfloat16))
+    assert not any(f_.op == "double-round" for f_ in rep.findings)
+
+
+def test_dropped_master_weight_cast_fires():
+    """ISSUE seed: a bf16 'master_params' leaf under the O2 policy is
+    the exact failure f32 masters exist to prevent."""
+    props = amp.initialize(opt_level="O2", verbosity=0).properties
+    state = {"master_params": {"w": jnp.ones((4,), jnp.bfloat16)},
+             "opt_state": {"m": jnp.zeros((4,), jnp.float32)}}
+
+    def f(state, x):
+        return jnp.sum(state["master_params"]["w"].astype(jnp.float32) * x
+                       + state["opt_state"]["m"])
+
+    rep = _run(f, state, jnp.ones(4), policy=props)
+    errs = [f_ for f_ in rep.findings if f_.op == "master-weight-dtype"]
+    assert len(errs) == 1 and errs[0].severity == "error"
+    assert errs[0].dtype == "bfloat16"
+
+
+def test_bf16_moment_fires_and_f32_masters_clean():
+    props = amp.initialize(opt_level="O2", verbosity=0).properties
+    state = {"master_params": {"w": jnp.ones((4,), jnp.float32)},
+             "opt_state": {"m": jnp.zeros((4,), jnp.bfloat16)}}
+
+    def f(state, x):
+        return jnp.sum(state["master_params"]["w"] * x
+                       + state["opt_state"]["m"].astype(jnp.float32))
+
+    rep = _run(f, state, jnp.ones(4), policy=props)
+    errs = [f_ for f_ in rep.findings if f_.op == "master-weight-dtype"]
+    assert len(errs) == 1 and "optimizer moment" in errs[0].message
+
+    clean = {"master_params": {"w": jnp.ones((4,), jnp.float32)},
+             "opt_state": {"m": jnp.zeros((4,), jnp.float32)}}
+    rep = _run(f, clean, jnp.ones(4), policy=props)
+    assert not any(f_.op == "master-weight-dtype" for f_ in rep.findings)
+
+
+def test_o1_no_masters_policy_does_not_gate_arg_dtypes():
+    """Under O1 (no master copies resolved) a 16-bit leaf that happens
+    to be NAMED master_params is not a contract violation."""
+    props = amp.initialize(opt_level="O1", verbosity=0).properties
+    state = {"master_params": {"w": jnp.ones((4,), jnp.bfloat16)}}
+
+    def f(state, x):
+        return jnp.sum(state["master_params"]["w"].astype(jnp.float32) * x)
+
+    rep = _run(f, state, jnp.ones(4), policy=props)
+    assert not any(f_.op == "master-weight-dtype" for f_ in rep.findings)
+
+
+def test_misordered_unscale_fires():
+    """ISSUE seed: scaled gradients reaching the returned update — the
+    unscale never dominated the use."""
+    def bad(params, box, x):
+        g = jax.grad(
+            lambda p: jnp.sum((x @ p) ** 2) * box["loss_scale"])(params)
+        return params - 0.1 * g           # update integrates SCALED grads
+
+    rep = _run(bad, jnp.ones((4, 2)), {"loss_scale": jnp.float32(1024.0)},
+               jnp.ones((3, 4)))
+    errs = [f_ for f_ in rep.findings if f_.op == "unscaled-grad-use"]
+    assert errs and all(f_.severity == "error" for f_ in errs)
+
+
+def test_correct_scale_placement_is_clean_and_counted():
+    def good(params, box, x):
+        s = box["loss_scale"]
+        g = jax.grad(lambda p: jnp.sum((x @ p) ** 2) * s)(params)
+        return params - 0.1 * (g / s)     # unscale dominates the update
+
+    lowered = analysis.lower_quiet(
+        jax.jit(good), jnp.ones((4, 2)),
+        {"loss_scale": jnp.float32(1024.0)}, jnp.ones((3, 4)))
+    ctx = analysis.build_context(lowered, compile=False)
+    findings, stats = precision_report(ctx)
+    assert not any(f.severity == "error" for f in findings)
+    assert stats["scale_args"] == 1
+    assert stats["scale_applied"] >= 1 and stats["unscaled"] >= 1
+
+
+def test_unapplied_loss_scale_warns():
+    """Unscaling gradients that were never scaled is the placement
+    contract violated in the other direction."""
+    def f(params, box, x):
+        g = jax.grad(lambda p: jnp.sum((x @ p) ** 2))(params)
+        return params - 0.1 * (g / box["loss_scale"])
+
+    rep = _run(f, jnp.ones((4, 2)), {"loss_scale": jnp.float32(1024.0)},
+               jnp.ones((3, 4)))
+    assert any(f_.op == "loss-scale-unused" and f_.severity == "warning"
+               for f_ in rep.findings)
+
+
+def test_o3_demotes_dtype_findings_to_info():
+    """O3 is the documented "speed of light, unsafe" level: the dtype
+    findings stay visible but must not fail a lane that opted out."""
+    props = amp.initialize(opt_level="O3", verbosity=0).properties
+
+    def f(x):
+        return jax.lax.reduce(x, jnp.bfloat16(0), jax.lax.add, (0,))
+
+    rep = _run(f, jnp.ones((4096,), jnp.bfloat16), policy=props)
+    finds = [f_ for f_ in rep.findings if f_.op == "low-precision-reduce"]
+    assert finds and all(f_.severity == "info" for f_ in finds)
+    assert rep.ok
+
+
+# ---------------------------------------------------------------------------
+# the dflow walker's SSA view (parser pins on crafted StableHLO)
+# ---------------------------------------------------------------------------
+
+_CRAFTED = """\
+module @jit_f {
+  func.func public @main(%arg0: tensor<4x8xf32> {jax.result_info = ""}, %arg1: tensor<8xbf16>) -> (tensor<8xbf16>) {
+    %0 = stablehlo.constant dense<1.0> : tensor<4x8xf32>
+    %1 = stablehlo.add %arg0, %0 : tensor<4x8xf32>
+    %2 = stablehlo.reduce(%1 init: %cst) applies stablehlo.add across dimensions = [0] : (tensor<4x8xf32>, tensor<f32>) -> tensor<8xf32>
+    %3 = stablehlo.convert %2 : (tensor<8xf32>) -> tensor<8xbf16>
+    %4:2 = stablehlo.while(%iterArg = %3, %iterArg_0 = %arg1) : tensor<8xbf16>, tensor<8xbf16>
+     cond {
+      stablehlo.return %c : tensor<i1>
+    } do {
+      %5 = stablehlo.multiply %iterArg, %iterArg_0 : tensor<8xbf16>
+      stablehlo.return %5, %iterArg_0 : tensor<8xbf16>, tensor<8xbf16>
+    }
+    return %4#0 : tensor<8xbf16>
+  }
+}
+"""
+
+
+def test_dflow_parses_ops_types_and_regions():
+    funcs = dflow.parse_module(_CRAFTED)
+    main = dflow.main_func(funcs)
+    assert main is not None and main.name == "main"
+    assert main.args == [("%arg0", "4x8xf32"), ("%arg1", "8xbf16")]
+    by_name = {}
+    for op in main.ops:
+        by_name.setdefault(op.name, op)
+    red = by_name["reduce"]
+    assert red.result_elem == "f32" and red.reduce_dims() == (4,)
+    assert red.reduced_elems() == 4
+    conv = by_name["convert"]
+    assert conv.operand_elems()[0] == "f32" and conv.result_elem == "bf16"
+    # while-header bindings recorded as aliases; region returns attributed
+    wh = by_name["while"]
+    assert main.resolve("%iterArg") == "%3"
+    assert ("%5", "%iterArg_0") in wh.region_returns
+    # the outer func return is separated from the region returns
+    assert len(main.returns) == 1
+    assert main.returns[0].operands == ("%4#0",)
+
+
+def test_dflow_use_counts_and_consumers():
+    funcs = dflow.parse_module(_CRAFTED)
+    main = funcs["main"]
+    assert main.use_count["%arg0"] == 1
+    assert any(op.name == "convert" for op in main.consumers["%2"])
+
+
+# ---------------------------------------------------------------------------
+# real lanes lint clean (the committed-artifact guarantee, enforced live)
+# ---------------------------------------------------------------------------
+
+#: bert/gpt/resnet model builds + lowerings cost 10s+ each on the
+#: 2-vCPU tier-1 box — slow-marked like the graph-lint lanes; mlp keeps
+#: the guarantee continuously enforced at both opt levels.
+HEAVY_FAMILIES = ("resnet", "gpt", "bert")
+
+
+def _marks_for(name):
+    return (pytest.mark.slow,) if name in HEAVY_FAMILIES else ()
+
+
+@pytest.mark.parametrize("family",
+                         [pytest.param(f, id=f, marks=_marks_for(f))
+                          for f in ["mlp", "resnet", "gpt", "bert"]])
+@pytest.mark.parametrize("opt_level", ["O1", "O2"])
+def test_family_train_lane_precision_clean(family, opt_level):
+    import graph_lint
+    rep = graph_lint.lint_family(family, passes=("precision",),
+                                 compile=False, opt_level=opt_level)
+    assert rep.ok, rep.format()
+    assert rep.passes == ("precision",) or "precision" in rep.passes
+    summary = [f for f in rep.findings if f.op == "precision-summary"]
+    # the clean verdict is meaningful only with evidence the pass looked
+    assert summary and "0 matmul" not in summary[0].message
+
+
+# ---------------------------------------------------------------------------
+# PRECLINT artifact schema + committed round
+# ---------------------------------------------------------------------------
+
+def _lane(ok=True, errors=0):
+    return {"ok": ok,
+            "findings": {"error": errors, "info": 1},
+            "checked": {k: 0 for k in ("dots", "reduces", "converts",
+                                       "collectives", "scale_args",
+                                       "scale_applied", "unscaled")}}
+
+
+def test_committed_preclint_artifact_is_schema_valid():
+    assert validate_preclint_file(str(REPO / "PRECLINT_r01.json")) == []
+
+
+def test_preclint_schema_rejects_malformed_documents():
+    assert validate_preclint("not a dict")
+    assert any("lanes" in p for p in validate_preclint(
+        {"round": 1, "platform": "cpu", "half_dtype": "bfloat16",
+         "lanes": {}}))
+    doc = {"round": 1, "platform": "cpu", "half_dtype": "bfloat16",
+           "lanes": {"mlp_o1_train": _lane()}}
+    assert validate_preclint(doc) == []
+    # missing counters
+    bad = {**doc, "lanes": {"x": {"ok": True, "findings": {},
+                                  "checked": {"dots": 1}}}}
+    assert validate_preclint(bad)
+
+
+def test_preclint_schema_rejects_contradictory_verdict():
+    """ok=True with error findings (or the reverse) is internally
+    inconsistent — the verdict must be derivable from the document."""
+    doc = {"round": 1, "platform": "cpu", "half_dtype": "bfloat16",
+           "lanes": {"mlp_o1_train": _lane(ok=True, errors=2)}}
+    assert any("contradicts" in p for p in validate_preclint(doc))
+    doc["lanes"]["mlp_o1_train"] = _lane(ok=False, errors=0)
+    assert any("contradicts" in p for p in validate_preclint(doc))

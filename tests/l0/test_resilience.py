@@ -195,6 +195,132 @@ def test_close_stops_writer_thread(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# re-save crash window + transient-IO restore (ROADMAP open items a, b)
+# ---------------------------------------------------------------------------
+
+def test_resave_crash_window_keeps_old_snapshot(tmp_path, monkeypatch):
+    """ROADMAP item (a): a crash while re-saving an existing step must
+    never lose the last good snapshot — the old directory is renamed
+    aside before the commit and restored on failure, not rmtree'd."""
+    import apex_tpu.resilience.durable as durable
+
+    p_old = {"w": np.arange(4.0)}
+    durable.write_snapshot(str(tmp_path), 7, p_old)
+
+    real_replace = os.replace
+
+    def exploding(src, dst):
+        if os.path.basename(str(src)).startswith(".tmp-"):
+            raise OSError(5, "simulated crash in the commit window")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(durable.os, "replace", exploding)
+    with pytest.raises(OSError):
+        durable.write_snapshot(str(tmp_path), 7, {"w": np.arange(4.0) * 2})
+    monkeypatch.undo()
+
+    # the OLD snapshot survived the failed commit, under its final name
+    values, manifest = durable.read_snapshot(str(tmp_path / "step_00000007"))
+    assert manifest["step"] == 7
+    np.testing.assert_array_equal(next(iter(values.values())), p_old["w"])
+    # no aside/tmp litter either
+    assert [n for n in os.listdir(tmp_path)
+            if n.startswith((".old-", ".tmp-"))] == []
+
+
+def test_resave_commits_new_payload_and_drops_aside(tmp_path):
+    import apex_tpu.resilience.durable as durable
+
+    durable.write_snapshot(str(tmp_path), 7, {"w": np.arange(4.0)})
+    durable.write_snapshot(str(tmp_path), 7, {"w": np.arange(4.0) * 2})
+    values, _ = durable.read_snapshot(str(tmp_path / "step_00000007"))
+    np.testing.assert_array_equal(next(iter(values.values())),
+                                  np.arange(4.0) * 2)
+    assert [n for n in os.listdir(tmp_path)
+            if n.startswith((".old-", ".tmp-"))] == []
+
+
+def test_process_crash_between_aside_and_commit_recovers(tmp_path):
+    """The hard window — process death after rename-aside, before the
+    commit rename: manager construction must rename the aside copy back
+    (it IS the last good snapshot), while post-commit aside garbage is
+    swept."""
+    import apex_tpu.resilience.durable as durable
+
+    durable.write_snapshot(str(tmp_path), 2, {"w": np.ones(3)})
+    final = tmp_path / "step_00000002"
+    os.replace(final, tmp_path / ".old-step_00000002-123-456")
+
+    mgr = DurableCheckpointManager(str(tmp_path))
+    assert final.is_dir()
+    assert not (tmp_path / ".old-step_00000002-123-456").exists()
+    assert mgr.latest_step() == 2
+    ok, problems = verify_snapshot(str(final))
+    assert ok, problems
+
+    # post-commit garbage variant: both exist -> the aside is swept
+    durable.write_snapshot(str(tmp_path), 2, {"w": np.ones(3) * 2})
+    stale = tmp_path / ".old-step_00000002-9-9"
+    stale.mkdir()
+    DurableCheckpointManager(str(tmp_path))
+    assert not stale.exists() and final.is_dir()
+    values, _ = durable.read_snapshot(str(final))
+    np.testing.assert_array_equal(next(iter(values.values())),
+                                  np.ones(3) * 2)
+
+
+def test_transient_leaf_read_oserror_is_retryable(tmp_path, monkeypatch):
+    """ROADMAP item (b): a transient leaf-read OSError must propagate
+    from read_snapshot — wrapping it as CheckpointCorruptError made
+    retry_io-driven restores silently fall back to an older step."""
+    import builtins
+
+    from apex_tpu.resilience.durable import read_snapshot
+
+    _a, step, state, batch = _workload()
+    mgr = DurableCheckpointManager(str(tmp_path))
+    mgr.save(0, state)
+    state1, _ = step(state, *batch(0))
+    mgr.save(1, state1)
+    mgr.wait()
+
+    real_open = builtins.open
+    flakes = {"n": 2}
+
+    def flaky_open(file, *a, **k):
+        name = str(file)
+        if "step_00000001" in name and "leaf_" in name and flakes["n"] > 0:
+            flakes["n"] -= 1
+            raise OSError(5, "Input/output error", name)
+        return real_open(file, *a, **k)
+
+    monkeypatch.setattr(builtins, "open", flaky_open)
+    # the raw read raises the RETRYABLE class, not corruption
+    with pytest.raises(OSError) as ei:
+        read_snapshot(str(tmp_path / "step_00000001"))
+    assert not isinstance(ei.value, CheckpointCorruptError)
+
+    # through the loop's retry wrapper the SAME step restores — the
+    # pre-fix behavior was a silent fallback to step 0
+    restored, _ = retry_io(lambda: mgr.restore(state1), retries=3,
+                           backoff_s=0.0)
+    assert mgr.last_restore["step"] == 1
+    assert flakes["n"] == 0
+
+
+def test_missing_leaf_file_is_still_corrupt(tmp_path):
+    """A leaf named by the manifest but absent on disk is structure
+    damage (a truncated commit), not weather — stays corrupt so restore
+    falls back."""
+    import apex_tpu.resilience.durable as durable
+
+    durable.write_snapshot(str(tmp_path), 0, {"w": np.ones(3)})
+    os.unlink(tmp_path / "step_00000000" / "leaf_00000.npy")
+    with pytest.raises(CheckpointCorruptError, match="missing"):
+        durable.read_snapshot(str(tmp_path / "step_00000000"))
+
+
+# ---------------------------------------------------------------------------
 # the self-healing loop
 # ---------------------------------------------------------------------------
 
@@ -338,6 +464,48 @@ def test_normal_overflow_skip_is_not_pathological():
     assert result.rewinds == 0
     assert result.steps_completed == 8
     assert np.isfinite(result.losses[-1][1])
+
+
+def test_managerless_non_ampstate_checkpoints(tmp_path):
+    """ROADMAP item (c): managerless run_resilient with a generic
+    pytree state used to crash in ckpt.state_dict(st) at the first
+    checkpoint step despite the hasattr guards."""
+    def step_fn(st, x):
+        w = st["w"] - 0.1 * x
+        return {"w": w}, {"loss": jnp.sum(w ** 2)}
+
+    cfg = ResilienceConfig(checkpoint_every=2)
+    result = run_resilient(jax.jit(step_fn), {"w": jnp.ones(4)},
+                           lambda i: (jnp.full((4,), 0.01),), 6,
+                           config=cfg)
+    assert result.steps_completed == 6
+    assert sum(1 for e in result.events if e["event"] == "checkpoint") == 3
+    assert np.isfinite(result.losses[-1][1])
+
+
+def test_managerless_non_ampstate_rewinds_from_memory_snapshot():
+    """The in-memory snapshot must also restore a generic pytree state
+    (the rewind path used AmpState-only load_state_dict)."""
+    def step_fn(st, x):
+        w = st["w"] * 0.9 + x
+        return {"w": w}, {"loss": jnp.sum(w)}
+
+    fired = {"done": False}
+
+    def batch(i):
+        if i == 4 and not fired["done"]:
+            fired["done"] = True
+            return (jnp.full((4,), jnp.nan),)
+        return (jnp.full((4,), 0.1),)
+
+    cfg = ResilienceConfig(checkpoint_every=2, max_rewinds=2)
+    result = run_resilient(jax.jit(step_fn), {"w": jnp.ones(4)}, batch, 8,
+                           config=cfg)
+    assert result.rewinds == 1
+    rewind = [e for e in result.events if e["event"] == "rewind"][0]
+    assert rewind["to_step"] == 3          # snapshots at 1 and 3; 4 NaN'd
+    assert result.steps_completed == 8
+    assert np.all(np.isfinite(np.asarray(result.state["w"])))
 
 
 def test_run_without_faults_matches_plain_loop():

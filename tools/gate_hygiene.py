@@ -22,7 +22,13 @@ This check fails (exit 1) when
   platform, non-empty lanes each carrying ``peak_hbm_bytes`` / the
   donation-aliasing table / cost-model numbers) — the static HBM
   story of every lane is gate memory the same way the kernel floors
-  are.
+  are, or
+- a committed ``PRECLINT_r*.json`` does not validate against the
+  precision-lint schema (``apex_tpu/analysis/preclint.py``: round,
+  platform, half_dtype, non-empty lanes each carrying the verdict,
+  finding counts, and the pass's evidence counters) — the
+  mixed-precision contract verdict of every O0–O3 lane is gate
+  memory too.
 
 It is wired into tier-1 (``tests/l0/test_gate_hygiene.py``), so a round
 cannot go green with dirty gate memory.  Best-effort on the VCS side:
@@ -53,13 +59,17 @@ REQUIRED = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json")
 #: evidence the same way).
 PATTERNS = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json",
             "BENCH_VARIANCE.json", "KERNELBENCH_r*.json",
-            "BENCH_r*.json", "INCIDENT_r*.json", "MEMLINT_r*.json")
+            "BENCH_r*.json", "INCIDENT_r*.json", "MEMLINT_r*.json",
+            "PRECLINT_r*.json")
 
 #: Round-numbered incident artifacts additionally get schema-validated.
 INCIDENT_PATTERN = "INCIDENT_r*.json"
 
-#: ... and so do the memory-lint artifacts (graph_lint --emit-json).
+#: ... and so do the memory-lint artifacts (graph_lint --emit-json) ...
 MEMLINT_PATTERN = "MEMLINT_r*.json"
+
+#: ... and the precision-lint artifacts.
+PRECLINT_PATTERN = "PRECLINT_r*.json"
 
 
 def _load_by_path(repo: str, *rel: str):
@@ -103,6 +113,19 @@ def _validate_memlints(repo: str) -> "list[str]":
     return problems
 
 
+def _validate_preclints(repo: str) -> "list[str]":
+    """Schema problems over every present PRECLINT_r*.json, as
+    ``path: problem`` strings (``apex_tpu/analysis/preclint.py``)."""
+    preclint = _load_by_path(repo, "apex_tpu", "analysis", "preclint.py")
+    if preclint is None:
+        return []
+    problems = []
+    for p in sorted(Path(repo).glob(PRECLINT_PATTERN)):
+        for msg in preclint.validate_preclint_file(str(p)):
+            problems.append(f"{p.name}: {msg}")
+    return problems
+
+
 def _git(repo: str, *args: str) -> "str | None":
     """stdout of a git command, or None when git/The repo is unavailable
     (the best-effort contract)."""
@@ -120,14 +143,14 @@ def _git(repo: str, *args: str) -> "str | None":
 def check(repo: str = str(REPO)) -> dict:
     """``{"ok": bool, "missing": [...], "untracked": [...],
     "dirty": [...], "invalid_incidents": [...],
-    "invalid_memlints": [...]}`` — see the module docstring for the
-    rules."""
+    "invalid_memlints": [...], "invalid_preclints": [...]}`` — see the
+    module docstring for the rules."""
     tracked_raw = _git(repo, "ls-files", "--", *PATTERNS)
     if tracked_raw is None:
         return {"ok": True, "skipped": "not a git checkout (or no git): "
                                        "hygiene unverifiable", "missing": [],
                 "untracked": [], "dirty": [], "invalid_incidents": [],
-                "invalid_memlints": []}
+                "invalid_memlints": [], "invalid_preclints": []}
     tracked = set(tracked_raw.split())
     missing = [f for f in REQUIRED
                if not (Path(repo) / f).exists() or f not in tracked]
@@ -149,11 +172,13 @@ def check(repo: str = str(REPO)) -> dict:
             dirty.append(path)
     invalid = _validate_incidents(repo)
     invalid_mem = _validate_memlints(repo)
+    invalid_prec = _validate_preclints(repo)
     return {"ok": not (missing or untracked or dirty or invalid
-                       or invalid_mem),
+                       or invalid_mem or invalid_prec),
             "missing": missing, "untracked": untracked, "dirty": dirty,
             "invalid_incidents": invalid,
-            "invalid_memlints": invalid_mem}
+            "invalid_memlints": invalid_mem,
+            "invalid_preclints": invalid_prec}
 
 
 def main(argv=None) -> int:
@@ -167,7 +192,8 @@ def main(argv=None) -> int:
               f"missing/untracked {verdict['missing'] + verdict['untracked']},"
               f" modified {verdict['dirty']}; invalid incident records "
               f"{verdict.get('invalid_incidents', [])}; invalid memlint "
-              f"records {verdict.get('invalid_memlints', [])}",
+              f"records {verdict.get('invalid_memlints', [])}; invalid "
+              f"preclint records {verdict.get('invalid_preclints', [])}",
               file=sys.stderr)
         return 1
     return 0

@@ -37,11 +37,22 @@ One JSON line per lane plus a human summary; exit 1 on any finding of
 ``error`` severity — wired as ``tests/l0/test_graph_lint.py`` so the
 clean-program guarantee is continuously enforced.
 
+The **precision pass** (``apex_tpu/analysis/precision.py``) also runs
+on every lane, with the lane's resolved ``amp.policy.Properties`` in
+the PassContext: forced sub-f32 matmul accumulation, long 16-bit
+reductions, f32→16→f32 double rounds, non-f32 masters/moments under
+O2, and loss-scale placement (scale dominates the backward, unscale
+dominates the update).  ``--passes precision`` defaults to the full
+O0–O3 train matrix plus decode; ``--emit-json PRECLINT_rN.json``
+writes the committed precision artifact (schema in
+``apex_tpu/analysis/preclint.py``, validated by gate hygiene).
+
 Usage:
     python tools/graph_lint.py [--families mlp,gpt] [--passes donation,...]
-                               [--lanes o1,o2,decode] [--no-compile]
+                               [--lanes o0,o1,o2,o3,decode] [--no-compile]
                                [--memory-budget [BYTES]]
-                               [--emit-json MEMLINT_r01.json] [-v]
+                               [--emit-json MEMLINT_r01.json|PRECLINT_r01.json]
+                               [-v]
 """
 
 import argparse
@@ -82,7 +93,12 @@ GRAPH_PASSES = ("donation", "sharding", "collectives", "constant-capture")
 #: the compiled-evidence memory/cost/sync passes — run on every lane,
 #: sharing the lane's single lowering+compilation with the graph passes
 MEMLINT_PASSES = ("memory", "cost", "syncs")
-ALL_PASSES = GRAPH_PASSES + MEMLINT_PASSES + ("policy",)
+#: the precision-flow pass runs on every lane too (lowering-only; the
+#: lane's resolved amp policy rides in the PassContext)
+ALL_PASSES = GRAPH_PASSES + MEMLINT_PASSES + ("precision", "policy")
+
+#: train lanes the CLI can run (opt levels); decode rides separately
+TRAIN_LANES = ("o0", "o1", "o2", "o3")
 
 #: single-chip train steps imply ZERO collective bytes; any regression
 #: that introduces one (an accidental psum, a sharding annotation leak)
@@ -98,23 +114,25 @@ DECODE_LANES = {"decode_b1": (1, 8, 8), "decode_b2": (2, 8, 8)}
 
 
 def build_train_step(family: str, raw=None, opt_level: str = "O1"):
-    """(jitted_step, example_args): the full train step — FusedAdam,
-    dynamic loss scaling, Amp state donated — for one model family at
-    ``opt_level``.  ``raw`` reuses an already-built
-    ``(loss_fn, params, batch)``."""
+    """(jitted_step, example_args, properties): the full train step —
+    FusedAdam, dynamic loss scaling, Amp state donated — for one model
+    family at ``opt_level``, plus the resolved policy for the
+    precision pass's :class:`~apex_tpu.analysis.PassContext`.  ``raw``
+    reuses an already-built ``(loss_fn, params, batch)``."""
     loss_fn, params, batch = raw or policy_audit.RAW_CASES[family]()
     a = amp.initialize(optimizer=FusedAdam(lr=1e-3), opt_level=opt_level,
                        verbosity=0)
     state = a.init(params)
     step = jax.jit(amp.make_train_step(a, loss_fn), donate_argnums=0)
-    return step, (state, *batch)
+    return step, (state, *batch), a.properties
 
 
 def build_decode_step(batch: int = 1, prefill: int = 8,
                       new_tokens: int = 8):
-    """(jitted_decode, args, kwargs): the KV-cached generation step at
-    a tiny config in the bf16 serving layout — the program
-    ``apex_tpu.models.generate.generate`` dispatches."""
+    """(jitted_decode, args, kwargs, properties): the KV-cached
+    generation step at a tiny config in the bf16 serving layout — the
+    program ``apex_tpu.models.generate.generate`` dispatches — plus
+    the O2 serving policy it was cast under."""
     from importlib import import_module
     gen = import_module("apex_tpu.models.generate")   # the module —
     # ``apex_tpu.models`` re-exports the ``generate`` FUNCTION under
@@ -133,7 +151,7 @@ def build_decode_step(batch: int = 1, prefill: int = 8,
     args = (top, stacked, prompt, jnp.float32(0.0),
             jax.random.PRNGKey(0))
     kwargs = dict(cfg=cfg, max_new_tokens=new_tokens, sample=False)
-    return gen._generate_impl, args, kwargs
+    return gen._generate_impl, args, kwargs, a.properties
 
 
 def _memlint_options(memory_budget=None):
@@ -186,10 +204,11 @@ def lint_family(family: str, passes=ALL_PASSES, compile: bool = True,
     report = analysis.Report()
     ctx = None
     if step_passes:
-        step, args = build_train_step(family, raw=raw,
-                                      opt_level=opt_level)
+        step, args, props = build_train_step(family, raw=raw,
+                                             opt_level=opt_level)
         lowered = analysis.lower_quiet(step, *args)
-        ctx = analysis.build_context(lowered, compile=compile)
+        ctx = analysis.build_context(lowered, compile=compile,
+                                     policy=props)
         options = {"collectives":
                    {"budget": COLLECTIVE_BUDGETS.get(family, {})}}
         options.update(_memlint_options(memory_budget))
@@ -213,16 +232,18 @@ def lint_decode(lane: str, passes=None, compile: bool = True,
                 memory_budget=None, _collect=None):
     """Lint one decode lane (graph + memlint passes; no policy — the
     decode program is a bf16 serving forward by design)."""
-    passes = tuple(p for p in (passes or GRAPH_PASSES + MEMLINT_PASSES)
-                   if p != "policy")
+    passes = tuple(
+        p for p in (passes or GRAPH_PASSES + MEMLINT_PASSES
+                    + ("precision",))
+        if p != "policy")
     if not passes:
         # e.g. --passes policy: nothing applies to a decode lane —
         # skip before paying the build + XLA compilation
         return analysis.Report()
     batch, prefill, new_tokens = DECODE_LANES[lane]
-    fn, args, kwargs = build_decode_step(batch, prefill, new_tokens)
+    fn, args, kwargs, props = build_decode_step(batch, prefill, new_tokens)
     lowered = fn.lower(*args, **kwargs)
-    ctx = analysis.build_context(lowered, compile=compile)
+    ctx = analysis.build_context(lowered, compile=compile, policy=props)
     options = {"collectives": {"budget": {"total": 0}}}
     options.update(_memlint_options(memory_budget))
     report = analysis.run_passes(ctx, passes=passes, options=options)
@@ -350,6 +371,63 @@ def emit_memlint(path: str, families, memory_budget=None,
     return n_errors
 
 
+def emit_preclint(path: str, families, verbose: bool = False) -> int:
+    """Write the PRECLINT artifact: the precision pass over every
+    family's O0–O3 train lanes plus both decode lanes (lowering only —
+    the precision pass needs no compiled executable, so the full
+    18-lane matrix costs 18 lowerings and zero compiles).  Returns the
+    number of error findings across all lanes."""
+    from apex_tpu.analysis import precision as precision_mod
+
+    lanes: dict = {}
+    n_errors = 0
+
+    def record(name, ctx):
+        nonlocal n_errors
+        findings, stats = precision_mod.precision_report(ctx)
+        counts: dict = {}
+        for f in findings:
+            counts[f.severity] = counts.get(f.severity, 0) + 1
+        ok = counts.get("error", 0) == 0
+        n_errors += counts.get("error", 0)
+        lanes[name] = {"ok": ok, "findings": counts, "checked": stats}
+        if verbose or not ok:
+            print(f"--- {name} ---", file=sys.stderr)
+            for f in findings:
+                print(f"  [{f.severity}] {f.op}: {f.message}",
+                      file=sys.stderr)
+
+    for family in families:
+        raw = policy_audit.RAW_CASES[family]()   # one build, four lanes
+        for opt_level in ("O0", "O1", "O2", "O3"):
+            step, args, props = build_train_step(family, raw=raw,
+                                                 opt_level=opt_level)
+            lowered = analysis.lower_quiet(step, *args)
+            ctx = analysis.build_context(lowered, compile=False,
+                                         policy=props)
+            record(f"{family}_{opt_level.lower()}_train", ctx)
+    for lane, (b, p, n) in DECODE_LANES.items():
+        fn, args, kwargs, props = build_decode_step(b, p, n)
+        lowered = fn.lower(*args, **kwargs)
+        ctx = analysis.build_context(lowered, compile=False, policy=props)
+        record(lane, ctx)
+
+    import numpy as np
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    doc = {
+        "round": int(m.group(1)) if m else 0,
+        "platform": jax.devices()[0].platform,
+        "half_dtype": np.dtype(jnp.bfloat16).name,
+        "lanes": lanes,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"preclint artifact written: {path} ({len(lanes)} lanes)",
+          file=sys.stderr)
+    return n_errors
+
+
 def parse_bytes(text: str) -> int:
     """``"16GiB"`` / ``"512MiB"`` / ``"1048576"`` -> bytes."""
     m = re.fullmatch(r"\s*([0-9.]+)\s*([KMG]i?B)?\s*", text)
@@ -366,9 +444,12 @@ def main(argv=None) -> int:
                     help=f"comma list from {FAMILIES}")
     ap.add_argument("--passes", default=",".join(ALL_PASSES),
                     help=f"comma list from {ALL_PASSES}")
-    ap.add_argument("--lanes", default="o1,decode",
-                    help="comma list from o1,o2,decode (train opt "
-                         "levels + the decode lanes)")
+    ap.add_argument("--lanes", default=None,
+                    help="comma list from o0,o1,o2,o3,decode (train "
+                         "opt levels + the decode lanes); default "
+                         "o1,decode — except --passes precision, whose "
+                         "contract is the full O0–O3 matrix, where the "
+                         "default is o0,o1,o2,o3,decode")
     ap.add_argument("--no-compile", action="store_true",
                     help="lower only (donation falls back to lowering-"
                          "time aliasing; sharding/collectives/memory/"
@@ -378,25 +459,37 @@ def main(argv=None) -> int:
                     metavar="BYTES",
                     help="arm the per-device peak-HBM gate (bare flag "
                          "= v5e 16 GiB; 512MiB / 2GiB forms accepted)")
-    ap.add_argument("--emit-json", default=None, metavar="MEMLINT_rN.json",
-                    help="run ALL lanes (O1+O2 train, decode, multichip"
-                         " slices, calibration audit) and write the "
-                         "memory-lint artifact")
+    ap.add_argument("--emit-json", default=None,
+                    metavar="MEMLINT_rN.json|PRECLINT_rN.json",
+                    help="write a committed lint artifact, dispatched "
+                         "on the file name: MEMLINT_r*.json = all "
+                         "passes over O1+O2 train + decode + multichip "
+                         "slices + calibration audit; PRECLINT_r*.json "
+                         "= the precision pass over every O0–O3 train "
+                         "lane + decode (lowering only)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print every finding, not just errors")
     opts = ap.parse_args(argv)
 
     families = [f.strip() for f in opts.families.split(",") if f.strip()]
     passes = tuple(p.strip() for p in opts.passes.split(",") if p.strip())
+    lanes_explicit = opts.lanes is not None
+    if opts.lanes is None:
+        # the precision pass's documented contract is the full O0–O3
+        # matrix; every other pass combination keeps the historical
+        # o1,decode default
+        opts.lanes = "o0,o1,o2,o3,decode" if passes == ("precision",) \
+            else "o1,decode"
     lanes = [x.strip().lower() for x in opts.lanes.split(",") if x.strip()]
     unknown = [f for f in families if f not in FAMILIES]
     if unknown:
         ap.error(f"unknown families {unknown}; have {FAMILIES}")
-    bad_lanes = [x for x in lanes if x not in ("o1", "o2", "decode")]
+    bad_lanes = [x for x in lanes
+                 if x not in TRAIN_LANES + ("decode",)]
     if bad_lanes or not lanes:
         ap.error(f"unknown lanes {bad_lanes or opts.lanes!r}; have "
-                 f"o1, o2, decode — a typo'd lane list must not pass "
-                 f"the gate by linting nothing")
+                 f"{', '.join(TRAIN_LANES)}, decode — a typo'd lane "
+                 f"list must not pass the gate by linting nothing")
     try:
         budget = parse_bytes(opts.memory_budget) \
             if opts.memory_budget is not None else None
@@ -407,12 +500,55 @@ def main(argv=None) -> int:
                  "memory analysis; it cannot combine with "
                  "--no-compile (an armed budget that asserts nothing "
                  "must not pass the gate)")
+    # lowering-only pass sets never read the compiled executable: skip
+    # the (expensive) per-lane XLA compilation the same way the
+    # PRECLINT artifact path does — but an armed memory budget with no
+    # memory pass requested must be refused, not silently unasserted
+    lowering_only = set(passes) <= {"precision", "policy",
+                                    "constant-capture"}
+    if lowering_only and budget is not None:
+        ap.error("--memory-budget needs the memory pass; the requested "
+                 f"--passes {','.join(passes)} never reads it (an "
+                 "armed budget that asserts nothing must not pass "
+                 "the gate)")
+    if lowering_only and opts.emit_json is None:
+        # (not under --emit-json: the artifact branches own their
+        # compile story and their --passes diagnostics)
+        opts.no_compile = True
+
+    if opts.emit_json and \
+            os.path.basename(opts.emit_json).startswith("PRECLINT"):
+        # the precision artifact's contract is the full O0–O3 + decode
+        # matrix under the precision pass alone — a restricted run
+        # must be refused, never silently committed as a full document
+        if passes not in (ALL_PASSES, ("precision",)):
+            ap.error("--emit-json PRECLINT_r*.json runs exactly the "
+                     "precision pass over every lane; drop --passes "
+                     "(or pass --passes precision)")
+        if tuple(families) != FAMILIES:
+            ap.error("--emit-json PRECLINT_r*.json covers every model "
+                     "family; drop --families")
+        if lanes_explicit:
+            ap.error("--emit-json PRECLINT_r*.json always writes every "
+                     "lane (O0–O3 train + decode); drop --lanes")
+        if budget is not None:
+            ap.error("--memory-budget does not apply to the precision "
+                     "artifact (lowering-only; no compiled memory "
+                     "analysis) — an armed budget that asserts "
+                     "nothing must not pass the gate")
+        n_errors = emit_preclint(opts.emit_json, families,
+                                 verbose=opts.verbose)
+        if n_errors:
+            print(f"graph lint FAILED: {n_errors} precision error "
+                  f"finding(s) — see the artifact", file=sys.stderr)
+            return 1
+        return 0
 
     if opts.emit_json:
-        # the artifact's contract is the FULL matrix (all passes, every
-        # lane, compiled evidence) — silently honoring a restricted
-        # --passes or --no-compile would commit a partial document
-        # under the full schema
+        # the memlint artifact's contract is the FULL matrix (all
+        # passes, every lane, compiled evidence) — silently honoring a
+        # restricted --passes or --no-compile would commit a partial
+        # document under the full schema
         if opts.no_compile:
             ap.error("--emit-json needs compiled evidence (memory/"
                      "cost tables); it cannot combine with "
@@ -467,7 +603,7 @@ def main(argv=None) -> int:
             print(f"--- {label} ---\n{report.format()}", file=sys.stderr)
 
     for family in families:
-        for opt_level in ("O1", "O2"):
+        for opt_level in ("O0", "O1", "O2", "O3"):
             if opt_level.lower() not in lanes:
                 continue
             run(f"{family}_{opt_level.lower()}",
