@@ -31,6 +31,11 @@ This package provides the capabilities of NVIDIA Apex (reference:
   self-healing train loop with watchdog + divergence rewind (the
   reference's resume contract, ``apex/fp16_utils/fp16_optimizer.py:298-359``,
   extended to preemption / corruption / NaN-storm / hung-step inputs).
+- :mod:`apex_tpu.serve` — continuous-batching decode serving: fixed-slot
+  scheduler, paged block-pool KV cache with per-slot page tables, fused
+  on-device sampling epilogue, one compiled step that never retraces
+  across admission/retirement (no reference analog — 2019-era apex has
+  no inference story at all).
 
 Unlike the reference, which monkey-patches eager PyTorch, everything here is
 functional and jit-compiled: loss-scale state is a pytree carried through the
@@ -50,6 +55,7 @@ from apex_tpu import optimizers
 from apex_tpu import parallel
 from apex_tpu import resilience
 from apex_tpu import rnn
+from apex_tpu import serve
 
 #: The reference spells the RNN package ``apex.RNN`` (not auto-imported
 #: there; ``apex/__init__.py:1-13``) — keep the capitalized alias so
@@ -70,6 +76,7 @@ __all__ = [
     "parallel",
     "resilience",
     "rnn",
+    "serve",
     "RNN",
     "__version__",
 ]

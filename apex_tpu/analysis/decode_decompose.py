@@ -1,0 +1,145 @@
+"""DECODE_DECOMPOSE_r*.json — schema for the committed decode-step
+decomposition artifact.
+
+``tools/decode_decompose.py`` writes one of these per round: a
+D64-style device-time bucketing of the b8 decode step — where every
+byte of the step's HBM traffic goes (params vs KV read vs KV write vs
+attention compute vs sampling vs host sync), derived from a complete
+walk of the lowered StableHLO with explicit per-op conventions, and
+reconciled against the committed measured decode rate.  VERDICT r5 #6:
+b8 runs at 0.43 of the analytic HBM decode ceiling and nothing
+explains the gap — this artifact is the explanation's machine-checked
+form, and the serve engine's KV layout/dtype choices cite it.
+
+Like MEMLINT/PRECLINT/INCIDENT records, the artifact is gate memory:
+``tools/gate_hygiene.py`` validates every committed
+``DECODE_DECOMPOSE_r*.json`` against this schema, and the schema
+ENFORCES the acceptance bar — the named (non-``other``) buckets must
+account for at least :data:`MIN_COVERAGE` of the walked step traffic,
+so the decomposition can never rot into a document whose "explanation"
+is mostly an unexplained remainder.
+
+This module is deliberately **stdlib-only** (no jax import):
+``gate_hygiene`` loads it directly by file path the same way it loads
+``analysis/memlint.py`` and ``analysis/preclint.py``.
+
+Document shape::
+
+    {
+      "round": 1,
+      "platform": "cpu",              # backend the walk lowered for
+      "config": {"batch": 8, "prefill": 2048, "new_tokens": 256,
+                 "model": "gpt_small_tpu"},
+      "method": "stablehlo-walk",     # how the buckets were derived
+      "step_bytes": {                 # bytes/step, walk conventions
+        "total": 2.1e9,
+        "buckets": {"param_read": ..., "kv_read": ..., "kv_write": ...,
+                    "attention": ..., "sampling": ..., "host_sync": 0,
+                    "other": ...}
+      },
+      "device_time_fractions": {      # buckets / total (sum ~ 1)
+        "param_read": 0.12, ...
+      },
+      "coverage": 0.97,               # 1 - other fraction, >= 0.9
+      "measured": {...},              # committed-rate reconciliation
+      "gap_attribution": {...},       # residual vs static candidates
+      "note": "..."
+    }
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+#: every bucket the decomposition must account (``other`` is the
+#: explicit remainder; ``host_sync`` is a count-backed bucket that must
+#: be 0 bytes for a device-resident token loop)
+BUCKETS = ("param_read", "kv_read", "kv_write", "attention",
+           "sampling", "host_sync", "other")
+
+#: the acceptance bar: named buckets must cover >= 90% of the step
+MIN_COVERAGE = 0.9
+
+
+def validate_decompose(doc) -> List[str]:
+    """Problems with one parsed DECODE_DECOMPOSE document (empty =
+    valid)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not a JSON object"]
+    if not isinstance(doc.get("round"), int):
+        problems.append("missing/invalid 'round' (int)")
+    if not isinstance(doc.get("platform"), str):
+        problems.append("missing/invalid 'platform' (str)")
+    cfg = doc.get("config")
+    if not isinstance(cfg, dict) or not all(
+            isinstance(cfg.get(k), int)
+            for k in ("batch", "prefill", "new_tokens")):
+        problems.append("missing/invalid 'config' "
+                        "(batch/prefill/new_tokens ints)")
+    sb = doc.get("step_bytes")
+    buckets = None
+    if not isinstance(sb, dict) or not isinstance(sb.get("total"),
+                                                  (int, float)):
+        problems.append("missing/invalid 'step_bytes' (total + buckets)")
+    else:
+        buckets = sb.get("buckets")
+        if not isinstance(buckets, dict):
+            problems.append("'step_bytes' missing 'buckets' object")
+            buckets = None
+    if buckets is not None:
+        for k in BUCKETS:
+            v = buckets.get(k)
+            if not isinstance(v, (int, float)) or v < 0:
+                problems.append(f"bucket {k!r} missing or not a "
+                                f"non-negative number: {v!r}")
+        total = sb["total"]
+        if total > 0:
+            s = sum(v for k, v in buckets.items()
+                    if isinstance(v, (int, float)))
+            if not 0.98 <= s / total <= 1.02:
+                problems.append(
+                    f"buckets sum to {s:.4g}, not the stated total "
+                    f"{total:.4g} — the decomposition must be complete")
+    fr = doc.get("device_time_fractions")
+    if not isinstance(fr, dict) or not all(
+            isinstance(fr.get(k), (int, float)) for k in BUCKETS):
+        problems.append("missing/invalid 'device_time_fractions' "
+                        "(every bucket)")
+        fr = None
+    cov = doc.get("coverage")
+    if not isinstance(cov, (int, float)):
+        problems.append("missing/invalid 'coverage' (number)")
+    else:
+        if cov < MIN_COVERAGE:
+            problems.append(
+                f"coverage {cov} under the {MIN_COVERAGE} acceptance "
+                f"bar — the named buckets fail to account for the "
+                f"step")
+        if fr is not None:
+            derived = 1.0 - float(fr.get("other", 0.0))
+            if abs(cov - derived) > 0.02:
+                problems.append(
+                    f"coverage {cov} inconsistent with fractions "
+                    f"(1 - other = {derived:.4f})")
+    if fr is not None:
+        s = sum(float(fr[k]) for k in BUCKETS)
+        if not 0.95 <= s <= 1.05:
+            problems.append(f"device_time_fractions sum to {s:.4f}, "
+                            f"expected ~1")
+    meas = doc.get("measured")
+    if meas is not None and not isinstance(meas, dict):
+        problems.append("'measured' present but not an object")
+    return problems
+
+
+def validate_decompose_file(path: str) -> List[str]:
+    """Problems with one DECODE_DECOMPOSE_r*.json file (empty =
+    valid)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable decode-decompose JSON: {e}"]
+    return validate_decompose(doc)

@@ -73,14 +73,27 @@ def _ln(x, p, eps):
 
 def _attn_cached(q, k_cache, v_cache, valid_mask, scale):
     """fp32-softmax attention of ``q (B, Lq, H, D)`` against the full
-    cache ``(B, M, H, D)`` with an additive validity mask ``(Lq, M)``
-    (True = attend) — the decode analog of the kernel's conventions."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k_cache.astype(jnp.float32)) * scale
-    s = jnp.where(valid_mask[None, None], s, NEG_INF)
+    cache ``(B, M, H, D)`` with a validity mask (True = attend) of
+    shape ``(Lq, M)`` (shared across the batch — this module's decode/
+    prefill) or ``(B, Lq, M)`` (per-row — the serve engine's per-slot
+    live lengths, :func:`apex_tpu.serve.paged.paged_attention`
+    delegates here so the parity-critical math exists ONCE).
+
+    The fp32 accumulation rides ``preferred_element_type`` instead of
+    an ``astype(f32)`` on the cache operands: the bf16→f32 embed is
+    exact, so the scores are bitwise what the cast form produced, but
+    the (B, M, H, D) f32 cache copies are no longer in the program for
+    XLA to materialize — DECODE_DECOMPOSE_r01 found the per-step cache
+    converts/slice-copies to be the largest static candidates for the
+    b8 0.43-of-ceiling gap (kv_read is 69% of modeled step traffic)."""
+    mask = valid_mask[None, None] if valid_mask.ndim == 2 \
+        else valid_mask[:, None]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                   preferred_element_type=jnp.float32) * scale
+    s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p,
-                     v_cache.astype(jnp.float32))
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v_cache,
+                     preferred_element_type=jnp.float32)
     return out.astype(q.dtype)
 
 
@@ -116,22 +129,24 @@ def _block(x, p, cfg, kc, vc, layer_i, cos, sin, valid_mask, write_at):
         kc, k.astype(kc.dtype)[None], (layer_i, 0, write_at, 0, 0))
     vc = jax.lax.dynamic_update_slice(
         vc, v.astype(vc.dtype)[None], (layer_i, 0, write_at, 0, 0))
-    if lq > 1:
-        # prefill: rows 0..lq-1 attending to cache slots <= their own
-        # position IS causal self-attention over the (already-rotated)
-        # prompt q/k/v — run the production flash kernel instead of the
-        # cached einsum, whose (B, H, Lq, M) fp32 score tensor would
-        # materialize ~450 MB at b8/L2048.  Valid ONLY from an empty
-        # cache: a multi-token chunk appended mid-sequence would need
-        # the cached history this branch never reads.
-        if not _concrete_zero(write_at):
-            raise NotImplementedError(
-                "multi-token forward with a non-empty cache (chunked "
-                "prefill / speculative verify) is not supported: the "
-                "flash prefill attends only within the chunk")
+    if lq > 1 and _concrete_zero(write_at):
+        # full prefill: rows 0..lq-1 attending to cache slots <= their
+        # own position IS causal self-attention over the
+        # (already-rotated) prompt q/k/v — run the production flash
+        # kernel instead of the cached einsum, whose (B, H, Lq, M) fp32
+        # score tensor would materialize ~450 MB at b8/L2048.  Valid
+        # only from an empty cache: the kernel attends within the chunk.
         from apex_tpu.attention import attention
         o = attention(q, k, v, causal=True)
     else:
+        # single-token decode, or CHUNKED prefill (lq > 1 at a possibly
+        # traced mid-sequence ``write_at``): the chunk's own k/v are
+        # already in the cache (written above), so attending against
+        # the full cache under ``valid_mask`` — cache slot <= the row's
+        # global position — is causal-within-chunk PLUS full attention
+        # over the cached history.  The (B, H, Lq, M) score tensor is
+        # fine at serving chunk sizes (the serve engine admits prefills
+        # in ``ServeConfig.prefill_chunk``-token chunks).
         kc_l = jax.lax.dynamic_index_in_dim(kc, layer_i, 0,
                                             keepdims=False)
         vc_l = jax.lax.dynamic_index_in_dim(vc, layer_i, 0,
@@ -151,8 +166,10 @@ def _block(x, p, cfg, kc, vc, layer_i, cos, sin, valid_mask, write_at):
 def _forward_cached(params, stacked, cfg, ids, kc, vc, start: int):
     """Embed ``ids (B, Lq)`` at global positions ``start..start+Lq-1``,
     run all layers with cache writes at ``start``, return final-token
-    logits and updated caches.  ``start`` may be traced (decode) or 0
-    (prefill)."""
+    logits and updated caches.  ``start`` may be traced (decode and
+    chunked prefill — a multi-token chunk appended mid-sequence
+    attends to the cached history through the einsum path) or a
+    concrete 0 (full prefill through the flash kernel)."""
     c = cfg
     b, lq = ids.shape
     m = kc.shape[2]

@@ -1,0 +1,39 @@
+"""apex_tpu.serve — continuous-batching decode serving.
+
+Production serving over the training checkpoint: a fixed-slot
+continuous-batching scheduler (:mod:`apex_tpu.serve.scheduler`), a
+paged block-pool KV cache read through per-slot page tables
+(:mod:`apex_tpu.serve.paged`), a fused on-device sampling epilogue
+(:mod:`apex_tpu.serve.sampling`), and the engine tying them into ONE
+compiled decode step that never retraces across admission, retirement,
+or preemption (:mod:`apex_tpu.serve.engine`).  See
+``docs/source/serving.rst``.
+"""
+
+from apex_tpu.serve.engine import ServeConfig, ServeEngine
+from apex_tpu.serve.paged import (
+    BlockAllocator,
+    PoolExhausted,
+    TRASH_BLOCK,
+    gather_slot_kv,
+    make_pools,
+    paged_attention,
+    token_write_coords,
+)
+from apex_tpu.serve.sampling import sample_tokens
+from apex_tpu.serve.scheduler import Request, SlotScheduler
+
+__all__ = [
+    "BlockAllocator",
+    "PoolExhausted",
+    "Request",
+    "ServeConfig",
+    "ServeEngine",
+    "SlotScheduler",
+    "TRASH_BLOCK",
+    "gather_slot_kv",
+    "make_pools",
+    "paged_attention",
+    "sample_tokens",
+    "token_write_coords",
+]

@@ -1,0 +1,349 @@
+"""Continuous-batching decode engine over the paged KV cache.
+
+One compiled decode step serves the whole request stream.  The step
+function is shaped by ``ServeConfig`` alone — ``num_slots`` lanes, the
+``(L, num_blocks, block_size, H, D)`` pools, ``max_blocks_per_slot``
+page-table columns — and every per-request quantity (tokens, lengths,
+page-table rows, sampling knobs, the active mask) is a TRACED array
+mutated between steps by the scheduler, so admission and retirement
+never change a shape and XLA never retraces (``trace_counts`` pins it
+at runtime; the graph-lint serve lane pins it statically).
+
+Step anatomy (all device, one dispatch per generated token per batch):
+
+1. embed every slot's pending token at its own global position
+   (per-slot rope tables);
+2. layer scan (one compiled body): qkv projection, rope, paged cache
+   write at ``(layer, page_table[slot, t // bs], t % bs)`` — inactive
+   lanes write to the trash block — then attention of the 1-token
+   query against the page-table-gathered per-slot caches under the
+   per-slot validity mask (:func:`apex_tpu.serve.paged.paged_attention`,
+   op-for-op the monolithic decode math);
+3. the fused sampling epilogue (:mod:`apex_tpu.serve.sampling`) draws
+   every slot's next token inside the step and the PRNG keys ride the
+   donated carry — the host fetches only the ``(S,)`` token ids it
+   must stream anyway.
+
+The pools, page tables and keys are DONATED carries: the step updates
+them in place, the engine holds only the returned handles.
+
+Prefill is admitted in fixed-size chunks (``prefill_chunk`` tokens,
+padded, one compiled program regardless of prompt length) writing
+through the same page table — the chunked analog of
+:func:`apex_tpu.models.generate._forward_cached`'s chunked-prefill
+path, so a request enters mid-stream without a full-sequence recompute
+and without disturbing the running batch's shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.models.generate import (
+    _ln,
+    _stack_layer_params,
+)
+from apex_tpu.models.gpt import GPTConfig
+from apex_tpu.ops.rope import apply_rope, rope_tables
+from apex_tpu.serve import paged, sampling
+from apex_tpu.serve.paged import TRASH_BLOCK
+from apex_tpu.serve.scheduler import Request, SlotScheduler
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Shapes of the compiled serving step.  ``num_blocks`` includes
+    the reserved trash block, so ``num_blocks - 1`` blocks are usable;
+    per-slot context is ``max_blocks_per_slot * block_size`` tokens.
+    ``kv_dtype=None`` stores KV in the parameter dtype (bf16 under the
+    O2 serving cast — DECODE_DECOMPOSE_r01 attributes the b8 decode
+    step to cache reads, so the cache dtype IS the ceiling knob; int8
+    KV rides the fp8/int8 roadmap item)."""
+
+    num_slots: int = 4
+    block_size: int = 16
+    num_blocks: int = 33
+    max_blocks_per_slot: int = 8
+    prefill_chunk: int = 16
+    kv_dtype: Optional[Any] = None
+
+
+def _paged_block(x, p_l, cfg: GPTConfig, kc, vc, layer_i, cos, sin,
+                 blocks, offs, table, valid, scale):
+    """One transformer block over ``x (B, Lq, E)`` reading/writing the
+    paged pools — op-for-op the math of
+    :func:`apex_tpu.models.generate._block` (the bitwise-parity
+    contract with solo ``generate()`` lives or dies here; keep the
+    three in sync through THIS one function).  The decode step calls
+    it at ``(B=num_slots, Lq=1)``, the prefill chunk at ``(B=1,
+    Lq=chunk)``; either way the per-token write coordinates are the
+    flattened ``blocks``/``offs`` ``(B*Lq,)`` and ``valid`` is the
+    ``(B, Lq, M)`` causal-vs-cache mask."""
+    c = cfg
+    head_dim = c.hidden_size // c.num_heads
+    b, lq = x.shape[0], x.shape[1]
+    h = _ln(x, p_l["ln1"], c.layer_norm_eps)
+    qkv = h @ p_l["attention"]["qkv"]["kernel"] \
+        + p_l["attention"]["qkv"]["bias"].astype(h.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, lq, c.num_heads, head_dim)
+    k = k.reshape(b, lq, c.num_heads, head_dim)
+    v = v.reshape(b, lq, c.num_heads, head_dim)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    kc = kc.at[layer_i, blocks, offs].set(
+        k.reshape(b * lq, c.num_heads, head_dim).astype(kc.dtype))
+    vc = vc.at[layer_i, blocks, offs].set(
+        v.reshape(b * lq, c.num_heads, head_dim).astype(vc.dtype))
+    kg = paged.gather_slot_kv(
+        jax.lax.dynamic_index_in_dim(kc, layer_i, 0, keepdims=False),
+        table)
+    vg = paged.gather_slot_kv(
+        jax.lax.dynamic_index_in_dim(vc, layer_i, 0, keepdims=False),
+        table)
+    o = paged.paged_attention(q, kg, vg, valid, scale)
+    o = o.reshape(b, lq, c.hidden_size)
+    x = x + (o @ p_l["attention"]["out"]["kernel"]
+             + p_l["attention"]["out"]["bias"].astype(o.dtype))
+    h = _ln(x, p_l["ln2"], c.layer_norm_eps)
+    h = h @ p_l["ffn_in"]["kernel"] \
+        + p_l["ffn_in"]["bias"].astype(h.dtype)
+    h = jax.nn.gelu(h)
+    x = x + (h @ p_l["ffn_out"]["kernel"]
+             + p_l["ffn_out"]["bias"].astype(h.dtype))
+    return x, kc, vc
+
+
+class ServeEngine:
+    """Continuous-batching serving over a GPT training checkpoint (the
+    same parameter tree :func:`apex_tpu.models.generate.generate`
+    decodes — no weight conversion).
+
+    >>> eng = ServeEngine(params, cfg, ServeConfig())
+    >>> eng.submit(Request("a", prompt_ids, max_new_tokens=16))
+    >>> outputs = eng.run()          # {"a": generated token ids}
+
+    ``submit`` may be called at any time (between ``step()`` calls of a
+    live loop); ``run()`` drains queue and slots.
+    """
+
+    def __init__(self, params, cfg: GPTConfig, serve_cfg: ServeConfig):
+        self.cfg = cfg
+        self.scfg = serve_cfg
+        self.sched = SlotScheduler(
+            num_slots=serve_cfg.num_slots,
+            num_blocks=serve_cfg.num_blocks,
+            block_size=serve_cfg.block_size,
+            max_blocks_per_slot=serve_cfg.max_blocks_per_slot)
+        self.stacked = _stack_layer_params(params, cfg.num_layers)
+        self.top = {k: v for k, v in params.items()
+                    if not k.startswith("block_") and k != "layers"}
+        dtype = self.top["tok_emb"]["embedding"].dtype
+        kv_dtype = serve_cfg.kv_dtype or dtype
+        head_dim = cfg.hidden_size // cfg.num_heads
+        kc, vc = paged.make_pools(cfg.num_layers, serve_cfg.num_blocks,
+                                  serve_cfg.block_size, cfg.num_heads,
+                                  head_dim, kv_dtype)
+        keys = jnp.zeros((serve_cfg.num_slots, 2), jnp.uint32)
+        self.carry = {"kc": kc, "vc": vc, "keys": keys}
+        #: python-body executions of each traced function — a retrace
+        #: (shape drift across admit/retire) increments these past 1;
+        #: tests assert they stay there across a whole mixed stream
+        self.trace_counts = {"decode": 0, "prefill": 0, "sample1": 0}
+        self._decode_step = jax.jit(self._decode_body,
+                                    donate_argnums=(2,))
+        self._prefill_chunk = jax.jit(self._prefill_body,
+                                      donate_argnums=(2, 3))
+        self._sample_one = jax.jit(self._sample1_body)
+        self._outputs: Dict[str, np.ndarray] = {}
+
+    # -- compiled bodies ----------------------------------------------
+
+    def _sample1_body(self, logits, key, temp, top_k, top_p):
+        self.trace_counts["sample1"] += 1
+        return sampling.sample_tokens(logits, key, temp, top_k, top_p)
+
+    def _decode_body(self, top, stacked, carry, tokens, lengths, active,
+                     page_table, temp, top_k, top_p):
+        """One continuous-batching decode step over every slot; returns
+        ``(carry', sampled (S,))``."""
+        self.trace_counts["decode"] += 1
+        c = self.cfg
+        bs = self.scfg.block_size
+        kc, vc, keys = carry["kc"], carry["vc"], carry["keys"]
+        head_dim = c.hidden_size // c.num_heads
+        scale = 1.0 / float(head_dim) ** 0.5
+        s = tokens.shape[0]
+        m = self.scfg.max_blocks_per_slot * bs
+
+        x = top["tok_emb"]["embedding"][tokens][:, None]       # (S,1,E)
+        positions = lengths[:, None]                           # (S,1)
+        cos, sin = rope_tables(positions, head_dim, c.rope_theta)
+        blocks, offs = paged.token_write_coords(lengths, page_table,
+                                                bs, active)
+        # keys at cache positions <= the fed token's position (the one
+        # this step writes) are attendable; inactive lanes mask out
+        valid = (jnp.arange(m)[None, :] <= lengths[:, None]) \
+            & active[:, None]                                  # (S,M)
+        valid = valid[:, None, :]                              # (S,1,M)
+
+        def layer(lcarry, inputs):
+            x, kc, vc = lcarry
+            p_l, layer_i = inputs
+            x, kc, vc = _paged_block(x, p_l, c, kc, vc, layer_i, cos,
+                                     sin, blocks, offs, page_table,
+                                     valid, scale)
+            return (x, kc, vc), None
+
+        (x, kc, vc), _ = jax.lax.scan(
+            layer, (x, kc, vc), (stacked, jnp.arange(c.num_layers)))
+        x = _ln(x[:, -1:], top["ln_f"], c.layer_norm_eps)
+        logits = x[:, 0] @ top["lm_head"]["kernel"]            # (S,V)
+        toks, new_keys = sampling.sample_tokens(logits, keys, temp,
+                                                top_k, top_p)
+        toks = jnp.where(active, toks, tokens)
+        return {"kc": kc, "vc": vc, "keys": new_keys}, toks
+
+    def _prefill_body(self, top, stacked, kc, vc, table_row, chunk_ids,
+                      start, n_valid):
+        """Write one ``(1, prefill_chunk)`` prompt chunk of a single
+        slot through its page table at global positions ``start..`` and
+        return ``(kc, vc, last-valid-token logits (1, V))``.  Rows past
+        ``n_valid`` are padding: their cache writes route to the trash
+        block and their outputs are never read."""
+        self.trace_counts["prefill"] += 1
+        c = self.cfg
+        bs = self.scfg.block_size
+        mb = self.scfg.max_blocks_per_slot
+        head_dim = c.hidden_size // c.num_heads
+        scale = 1.0 / float(head_dim) ** 0.5
+        _, lq = chunk_ids.shape
+        m = mb * bs
+
+        x = top["tok_emb"]["embedding"][chunk_ids]             # (1,C,E)
+        pos = start + jnp.arange(lq)                           # (C,)
+        cos, sin = rope_tables(pos[None, :], head_dim, c.rope_theta)
+        in_chunk = jnp.arange(lq) < n_valid
+        blocks = jnp.where(
+            in_chunk, table_row[jnp.clip(pos // bs, 0, mb - 1)],
+            TRASH_BLOCK)
+        offs = pos % bs
+        # causal-vs-cache mask: cache slots <= the row's global
+        # position (history AND in-chunk causality at once)
+        valid = (jnp.arange(m)[None, :] <= pos[:, None])[None]  # (1,C,M)
+
+        def layer(lcarry, inputs):
+            x, kc, vc = lcarry
+            p_l, layer_i = inputs
+            x, kc, vc = _paged_block(x, p_l, c, kc, vc, layer_i, cos,
+                                     sin, blocks, offs,
+                                     table_row[None], valid, scale)
+            return (x, kc, vc), None
+
+        (x, kc, vc), _ = jax.lax.scan(
+            layer, (x, kc, vc), (stacked, jnp.arange(c.num_layers)))
+        x_last = jax.lax.dynamic_slice_in_dim(x, n_valid - 1, 1, axis=1)
+        x_last = _ln(x_last, top["ln_f"], c.layer_norm_eps)
+        logits = x_last[:, 0] @ top["lm_head"]["kernel"]       # (1,V)
+        return kc, vc, logits
+
+    # -- host loop -----------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        self.sched.submit(req)
+
+    def _run_prefill(self, slot: int, req: Request) -> None:
+        c = self.scfg.prefill_chunk
+        prompt = np.asarray(req.prompt, np.int32)
+        n = len(prompt)
+        padded = np.zeros((-(-n // c)) * c, np.int32)
+        padded[:n] = prompt
+        table_row = jnp.asarray(self.sched.page_table[slot])
+        kc, vc = self.carry["kc"], self.carry["vc"]
+        logits = None
+        for j in range(0, len(padded), c):
+            n_valid = min(c, n - j)
+            kc, vc, logits = self._prefill_chunk(
+                self.top, self.stacked, kc, vc, table_row,
+                jnp.asarray(padded[None, j:j + c]),
+                jnp.int32(j), jnp.int32(n_valid))
+        if req.resume_key is not None:
+            key = jnp.asarray(req.resume_key, jnp.uint32)[None]
+        else:
+            key = jax.random.PRNGKey(req.seed)[None].astype(jnp.uint32)
+        tok, new_key = self._sample_one(
+            logits, key,
+            jnp.full((1,), req.temperature, jnp.float32),
+            jnp.full((1,), req.top_k, jnp.int32),
+            jnp.full((1,), req.top_p, jnp.float32))
+        keys = self.carry["keys"].at[slot].set(new_key[0])
+        self.carry = {"kc": kc, "vc": vc, "keys": keys}
+        self.sched.arm(slot, int(np.asarray(tok)[0]), n)
+        # a 1-token budget (or an immediate EOS) finishes on the
+        # prefill sample itself — retire before the slot wastes a
+        # decode step past its budget
+        first = int(np.asarray(tok)[0])
+        done = req.max_new_tokens <= 1 or (
+            req.eos_id is not None and first == req.eos_id)
+        if done:
+            uid, out = self.sched.retire(slot)
+            self._outputs[uid] = out
+
+    def _admit_and_evict(self) -> None:
+        while True:
+            plan = self.sched.plan()
+            if plan is None:
+                return
+            if plan[0] == "evict":
+                slot = plan[1]
+                resume_key = np.asarray(self.carry["keys"][slot])
+                self.sched.preempt(slot, resume_key)
+            else:
+                _, slot, req = plan
+                self._run_prefill(slot, req)
+
+    def step(self) -> Dict[str, np.ndarray]:
+        """One step boundary: admit/evict, then one compiled decode
+        step over every slot; returns the requests that FINISHED this
+        step (``{uid: generated token ids}``)."""
+        self._admit_and_evict()
+        sched = self.sched
+        if not sched.active.any():
+            return {}
+        self.carry, toks = self._decode_step(
+            self.top, self.stacked, self.carry,
+            jnp.asarray(sched.last_tok), jnp.asarray(sched.lengths),
+            jnp.asarray(sched.active), jnp.asarray(sched.page_table),
+            jnp.asarray(sched.temperature), jnp.asarray(sched.top_k),
+            jnp.asarray(sched.top_p))
+        toks = np.asarray(toks)
+        finished: Dict[str, np.ndarray] = {}
+        for slot in range(sched.num_slots):
+            if not sched.active[slot]:
+                continue
+            if sched.record_token(slot, int(toks[slot])):
+                uid, out = sched.retire(slot)
+                finished[uid] = out
+        self._outputs.update(finished)
+        return finished
+
+    def run(self, max_steps: int = 100_000) -> Dict[str, np.ndarray]:
+        """Drain the queue and every slot; returns
+        ``{uid: generated token ids}`` for every request ever
+        submitted (the prompt is not repeated in the output)."""
+        steps = 0
+        while not self.sched.idle():
+            before = self.sched.n_active() + len(self.sched.queue)
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"serve loop exceeded {max_steps} steps with "
+                    f"{before} request(s) outstanding")
+        return dict(self._outputs)

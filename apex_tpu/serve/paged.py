@@ -1,0 +1,155 @@
+"""Paged KV cache: a physical block pool read through per-slot page
+tables.
+
+The monolithic decode cache (``apex_tpu.models.generate``) allocates
+``(L, B, M, H, D)`` up front — every slot pays ``max_seq`` whether it
+holds an 8-token or an 8K-token request, so HBM scales with
+``B × max_seq`` instead of live tokens.  This module is the
+PagedAttention-style (Kwon et al., SOSP '23) replacement the serve
+engine reads through:
+
+- the **pool** is ``(L, num_blocks, block_size, H, D)`` — one physical
+  allocation shared by every slot; a sequence owns a list of blocks,
+  and memory scales with the tokens actually cached;
+- each slot's **page table** row ``(max_blocks_per_slot,)`` maps its
+  logical block ``j`` (token positions ``j*block_size ..``) to a
+  physical block id, so the device-side read is one gather:
+  ``pool[layer][page_table]`` linearizes back to the exact monolithic
+  ``(S, M, H, D)`` layout — the indirection is pure data movement, so
+  it bitwise-matches the monolithic cache on the same token stream
+  (pinned in ``tests/l0/test_serve_paged.py``);
+- **physical block 0 is the trash block**: the allocator never hands
+  it out, every empty page-table entry points at it, and inactive
+  slots' masked writes land there — a scatter needs *some* in-range
+  target under XLA's static shapes, and routing to a reserved block
+  keeps garbage out of every real sequence without a branch.
+
+Allocation (:class:`BlockAllocator`) is host-side bookkeeping — a free
+list with ownership tracking, exercised BETWEEN decode steps by the
+scheduler, so the compiled step never sees it.  Eviction is a
+scheduler policy built on ``free()`` (preempt-and-recompute, see
+:mod:`apex_tpu.serve.scheduler`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+#: physical block id reserved as the write target for masked/inactive
+#: lanes; never allocated, never mapped by a live page-table entry
+TRASH_BLOCK = 0
+
+
+class PoolExhausted(RuntimeError):
+    """Raised by :meth:`BlockAllocator.alloc` when the pool cannot
+    serve the request; the scheduler catches it to drive eviction."""
+
+
+class BlockAllocator:
+    """Host-side free-list allocator over the physical block pool.
+
+    Invariants (enforced, tested):
+
+    - block 0 (:data:`TRASH_BLOCK`) is never allocated;
+    - a block has at most one owner; ``alloc`` never hands out a live
+      block, ``free`` rejects blocks the owner doesn't hold
+      (double-free and cross-owner frees raise ``ValueError``);
+    - ``free_count + live_count == num_blocks - 1`` at all times.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 physical blocks (1 trash + 1 usable), got "
+                f"{num_blocks}")
+        self.num_blocks = num_blocks
+        # pop() hands out low ids first — deterministic layouts in tests
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._owner: Dict[int, object] = {}
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def live_count(self) -> int:
+        return len(self._owner)
+
+    def alloc(self, n: int, owner: object) -> List[int]:
+        """``n`` physical block ids now owned by ``owner``; raises
+        :class:`PoolExhausted` (allocating nothing) when fewer than
+        ``n`` are free."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            raise PoolExhausted(
+                f"need {n} blocks, {len(self._free)} free "
+                f"(pool {self.num_blocks}, 1 reserved)")
+        blocks = [self._free.pop() for _ in range(n)]
+        for b in blocks:
+            self._owner[b] = owner
+        return blocks
+
+    def free(self, blocks: Sequence[int], owner: object) -> None:
+        """Return ``blocks`` to the pool; every block must currently be
+        owned by ``owner`` (the whole call is rejected atomically
+        otherwise — a bad free must not half-release a sequence)."""
+        for b in blocks:
+            if self._owner.get(b) is not owner:
+                raise ValueError(
+                    f"block {b} not owned by {owner!r} "
+                    f"(owner={self._owner.get(b)!r}) — double free or "
+                    f"cross-owner free")
+        for b in blocks:
+            del self._owner[b]
+            self._free.append(b)
+
+    def owned_by(self, owner: object) -> List[int]:
+        return sorted(b for b, o in self._owner.items() if o is owner)
+
+
+def make_pools(num_layers: int, num_blocks: int, block_size: int,
+               num_heads: int, head_dim: int, dtype):
+    """Zeroed ``(kc, vc)`` block pools
+    ``(L, num_blocks, block_size, H, D)``."""
+    shape = (num_layers, num_blocks, block_size, num_heads, head_dim)
+    kc = jnp.zeros(shape, dtype)
+    return kc, jnp.zeros_like(kc)
+
+
+def gather_slot_kv(pool_l: jax.Array, page_table: jax.Array) -> jax.Array:
+    """Linearize every slot's cache through its page table:
+    ``pool_l (num_blocks, bs, H, D)`` gathered by ``page_table (S,
+    max_blocks)`` → ``(S, max_blocks*bs, H, D)`` — position ``p`` of
+    slot ``s`` lands at ``[s, p]`` exactly as the monolithic layout
+    stores it (the bitwise-parity contract)."""
+    g = pool_l[page_table]                   # (S, MB, bs, H, D)
+    s, mb, bs, h, d = g.shape
+    return g.reshape(s, mb * bs, h, d)
+
+
+def token_write_coords(lengths: jax.Array, page_table: jax.Array,
+                       block_size: int, active: jax.Array):
+    """``(blocks, offsets)`` each ``(S,)`` for writing every slot's
+    NEXT token (global position ``lengths[s]``) into the pool; inactive
+    slots route to :data:`TRASH_BLOCK`."""
+    mb = page_table.shape[1]
+    idx = jnp.clip(lengths // block_size, 0, mb - 1)
+    blocks = jnp.take_along_axis(page_table, idx[:, None], axis=1)[:, 0]
+    blocks = jnp.where(active, blocks, TRASH_BLOCK)
+    return blocks, lengths % block_size
+
+
+def paged_attention(q: jax.Array, k_lin: jax.Array, v_lin: jax.Array,
+                    valid: jax.Array, scale: float) -> jax.Array:
+    """fp32-softmax attention of ``q (S, Lq, H, D)`` against the
+    linearized per-slot caches ``(S, M, H, D)`` under the boolean mask
+    ``valid (S, Lq, M)`` (True = attend; a per-slot batch dim so every
+    slot attends to its own live length).  Delegates to
+    :func:`apex_tpu.models.generate._attn_cached` — the serve-vs-solo
+    bitwise-parity contract requires the math to exist exactly once."""
+    from apex_tpu.models.generate import _attn_cached
+    return _attn_cached(q, k_lin, v_lin, valid, scale)

@@ -1,0 +1,77 @@
+"""Fused sampling epilogue: temperature / top-k / top-p + PRNG chain,
+entirely inside the compiled decode step.
+
+The classic serving mistake is sampling on the host: the step returns
+``(S, V)`` logits, Python applies temperature/top-k/top-p and feeds the
+token back — a ``S×V`` device→host→device round trip per generated
+token that serializes the decode loop against the Python thread.  Here
+the whole epilogue is jax ops fused into the step (the engine's step
+fetches only the ``(S,)`` sampled token ids it must stream anyway), and
+every knob is a TRACED per-slot array:
+
+- ``temperature (S,) f32`` — ``0`` selects greedy argmax for that slot
+  (a ``where``, not a Python branch: mixing greedy and sampling slots
+  in one batch never retraces);
+- ``top_k (S,) i32`` — ``<= 0`` disables the cutoff;
+- ``top_p (S,) f32`` — ``>= 1`` disables the nucleus cutoff; the
+  highest-probability token always survives both filters.
+
+One shared descending sort serves both filters; the categorical draw
+chains per-slot PRNG keys (``keys (S, 2) uint32`` ride the step's
+donated carry), so each slot's stream is reproducible regardless of
+which other requests shared its batch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models.generate import NEG_INF
+
+
+def sample_tokens(logits: jax.Array, keys: jax.Array,
+                  temperature: jax.Array, top_k: jax.Array,
+                  top_p: jax.Array):
+    """``(tokens (S,) i32, new_keys (S, 2))`` sampled from ``logits
+    (S, V)`` under per-slot knobs (see module docstring).  Pure and
+    shape-stable: every knob is traced, so sweeping temperature or
+    mixing greedy/sampling slots reuses the one compiled program."""
+    logits = logits.astype(jnp.float32)
+    s, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    # temperature guard: the scaled logits only reach the output for
+    # slots with temperature > 0, but the divide must stay finite for
+    # the greedy slots sharing the batch
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # one descending sort serves top-k (rank cutoff) and top-p
+    # (cumulative-mass cutoff); temperature > 0 preserves the order,
+    # so sorting the raw logits' order is the scaled order too
+    order = jnp.argsort(-logits, axis=-1)
+    sorted_scaled = jnp.take_along_axis(scaled, order, axis=-1)
+
+    ranks = jnp.arange(v)[None, :]
+    k_eff = jnp.where(top_k <= 0, v, jnp.minimum(top_k, v))[:, None]
+    keep_k = ranks < k_eff
+
+    probs = jax.nn.softmax(sorted_scaled, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep ranks whose PRECEDING mass is under top_p: the first token
+    # always survives, and the kept set is the smallest prefix whose
+    # mass reaches top_p (the standard nucleus convention)
+    keep_p = (cum - probs) < jnp.clip(top_p, 0.0, 1.0)[:, None]
+
+    keep = (keep_k & keep_p).at[:, 0].set(True)
+    masked = jnp.where(keep, sorted_scaled, NEG_INF)
+
+    def draw(key, row):
+        nk, sub = jax.random.split(key)
+        return nk, jax.random.categorical(sub, row)
+
+    new_keys, picked = jax.vmap(draw)(keys, masked)
+    sampled = jnp.take_along_axis(order, picked[:, None], axis=-1)[:, 0]
+    tokens = jnp.where(temperature > 0, sampled, greedy)
+    return tokens.astype(jnp.int32), new_keys

@@ -1,0 +1,269 @@
+"""Continuous-batching scheduler: fixed decode slots, iteration-level
+admission/retirement, block accounting, preempt-and-recompute eviction.
+
+Orca-style (Yu et al., OSDI '22) iteration-level batching under XLA's
+static-shape constraint: the compiled decode step always sees the SAME
+``num_slots``-wide arrays — requests are admitted into free slots and
+retired out of finished ones BETWEEN steps by mutating the host-side
+slot tables (page table rows, lengths, sampling knobs, active mask),
+never the program.  One lowering serves the whole stream; the engine's
+trace counter and the graph-lint serve lane both pin that.
+
+Scheduling policy (deliberately simple, deterministic, and tested —
+not clever):
+
+- **admission**: FIFO; a request is admitted when a slot is free AND
+  the allocator can cover its whole worst-case footprint
+  (``ceil((prompt + max_new) / block_size)`` blocks) up front, so a
+  running request can never die mid-decode for blocks;
+- **eviction**: when a slot is free but blocks are short, the
+  YOUNGEST-admitted active request is preempted (recompute-on-resume,
+  the vLLM recovery mode): its blocks return to the pool and a
+  continuation request — original prompt + every token generated so
+  far, remaining budget, the slot's live PRNG key — goes to the back
+  of the queue.  The oldest active request is never evicted
+  (progress guarantee), nothing is evicted just because the queue is
+  long — only a block shortage triggers it — and a CONTINUATION never
+  evicts anyone (a preempted request reclaiming its seat by preempting
+  its evictor ping-pongs the pool forever; with the guard, total
+  evictions are bounded by the number of fresh submissions);
+- **retirement**: a slot retires when its budget is spent or its
+  request's ``eos_id`` appears; its blocks free immediately and the
+  slot is admissible the same step boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from apex_tpu.serve.paged import BlockAllocator, PoolExhausted, TRASH_BLOCK
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.  ``temperature=0`` is greedy;
+    ``top_k<=0`` / ``top_p>=1`` disable those cutoffs; ``seed`` starts
+    the slot's PRNG chain (per-request — reproducible regardless of
+    batch-mates)."""
+
+    uid: str
+    prompt: np.ndarray
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    eos_id: Optional[int] = None
+    #: preemption internals: tokens generated before the last
+    #: preemption (already part of ``prompt`` for recompute), and the
+    #: PRNG key the slot held when preempted (resumes the chain)
+    prior_tokens: Tuple[int, ...] = ()
+    resume_key: Optional[np.ndarray] = None
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: Request
+    blocks: List[int]
+    emitted: List[int]
+    admit_seq: int
+
+
+class SlotScheduler:
+    """Host-side slot/queue/block bookkeeping for the serve engine (see
+    the module docstring for the policy).  Owns the fixed-shape numpy
+    tables the compiled step consumes; the engine owns the device
+    carries (pools, keys) and executes the admissions/evictions this
+    class plans."""
+
+    def __init__(self, num_slots: int, num_blocks: int, block_size: int,
+                 max_blocks_per_slot: int):
+        if num_slots < 1:
+            raise ValueError(f"num_slots={num_slots}")
+        self.num_slots = num_slots
+        self.block_size = block_size
+        self.max_blocks_per_slot = max_blocks_per_slot
+        self.max_context = max_blocks_per_slot * block_size
+        self.allocator = BlockAllocator(num_blocks)
+        self.queue: Deque[Request] = deque()
+        self.slots: List[Optional[_Slot]] = [None] * num_slots
+        self._admit_seq = 0
+        # the fixed-shape tables the compiled step reads every step
+        self.page_table = np.full((num_slots, max_blocks_per_slot),
+                                  TRASH_BLOCK, np.int32)
+        self.lengths = np.zeros(num_slots, np.int32)
+        self.last_tok = np.zeros(num_slots, np.int32)
+        self.active = np.zeros(num_slots, bool)
+        self.temperature = np.zeros(num_slots, np.float32)
+        self.top_k = np.zeros(num_slots, np.int32)
+        self.top_p = np.ones(num_slots, np.float32)
+
+    # -- queue side ----------------------------------------------------
+
+    def blocks_needed(self, req: Request) -> int:
+        total = len(req.prompt) + req.max_new_tokens
+        return -(-total // self.block_size)
+
+    def submit(self, req: Request) -> None:
+        """Validate and enqueue.  Requests that can NEVER run (context
+        over the per-slot page-table reach, footprint over the whole
+        pool) are rejected here, not deadlocked later."""
+        if len(req.prompt) < 1 or req.max_new_tokens < 1:
+            raise ValueError(
+                f"{req.uid}: need a non-empty prompt and "
+                f"max_new_tokens >= 1")
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.max_context:
+            raise ValueError(
+                f"{req.uid}: prompt+max_new = {total} exceeds the "
+                f"per-slot context {self.max_context} "
+                f"({self.max_blocks_per_slot} blocks x "
+                f"{self.block_size})")
+        if self.blocks_needed(req) > self.allocator.num_blocks - 1:
+            raise ValueError(
+                f"{req.uid}: needs {self.blocks_needed(req)} blocks, "
+                f"pool has {self.allocator.num_blocks - 1} usable")
+        self.queue.append(req)
+
+    # -- step-boundary planning ---------------------------------------
+
+    def free_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self.slots) if s is None]
+
+    def n_active(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    def plan(self):
+        """The next step-boundary action, or ``None`` to just decode:
+        ``("admit", slot, request)`` (blocks already allocated, tables
+        set — the engine runs the prefill) or ``("evict", slot)`` (the
+        engine snapshots the slot's PRNG key, then calls
+        :meth:`preempt`)."""
+        if not self.queue:
+            return None
+        free = self.free_slots()
+        if not free:
+            return None
+        req = self.queue[0]
+        need = self.blocks_needed(req)
+        try:
+            blocks = self.allocator.alloc(need, req)
+        except PoolExhausted:
+            # a preempted request must not preempt others: without
+            # this, a continuation and its evictor ping-pong the pool
+            # forever (observed in development) — each FRESH request
+            # may force at most one eviction chain, so total evictions
+            # are bounded by the number of submissions
+            if req.prior_tokens:
+                return None
+            victim = self._eviction_victim(need)
+            if victim is None:
+                return None
+            return ("evict", victim)
+        self.queue.popleft()
+        slot = free[0]
+        self._install(slot, req, blocks)
+        return ("admit", slot, req)
+
+    def _eviction_victim(self, need: int) -> Optional[int]:
+        """Youngest-admitted active slot whose blocks would make the
+        admission possible; never the only active slot."""
+        if self.n_active() < 2:
+            return None
+        cands = [(s.admit_seq, i) for i, s in enumerate(self.slots)
+                 if s is not None]
+        _seq, victim = max(cands)
+        freed = len(self.slots[victim].blocks)
+        if self.allocator.free_count + freed < need:
+            return None
+        return victim
+
+    def _install(self, slot: int, req: Request,
+                 blocks: List[int]) -> None:
+        self.slots[slot] = _Slot(request=req, blocks=blocks, emitted=[],
+                                 admit_seq=self._admit_seq)
+        self._admit_seq += 1
+        row = np.full(self.max_blocks_per_slot, TRASH_BLOCK, np.int32)
+        row[:len(blocks)] = blocks
+        self.page_table[slot] = row
+        self.lengths[slot] = 0          # engine sets after prefill
+        self.active[slot] = False       # engine arms after prefill
+        self.temperature[slot] = req.temperature
+        self.top_k[slot] = req.top_k
+        self.top_p[slot] = req.top_p
+
+    # -- engine callbacks ---------------------------------------------
+
+    def arm(self, slot: int, first_token: int, prompt_len: int) -> None:
+        """Prefill done: record the first sampled token and enter the
+        slot into the decode batch."""
+        self.slots[slot].emitted.append(int(first_token))
+        self.last_tok[slot] = int(first_token)
+        self.lengths[slot] = prompt_len
+        self.active[slot] = True
+
+    def record_token(self, slot: int, token: int) -> bool:
+        """Append one decoded token; returns True when the slot is
+        finished (budget spent or EOS)."""
+        s = self.slots[slot]
+        s.emitted.append(int(token))
+        self.last_tok[slot] = int(token)
+        self.lengths[slot] += 1
+        done = len(s.emitted) >= s.request.max_new_tokens
+        if s.request.eos_id is not None and int(token) == s.request.eos_id:
+            done = True
+        return done
+
+    def retire(self, slot: int) -> Tuple[str, np.ndarray]:
+        """Free the slot and its blocks; returns ``(uid, tokens)`` with
+        the request's FULL generated stream (pre-preemption tokens
+        included)."""
+        s = self.slots[slot]
+        self.allocator.free(s.blocks, s.request)
+        self._clear(slot)
+        toks = list(s.request.prior_tokens) + s.emitted
+        return s.request.uid, np.asarray(toks, np.int32)
+
+    def preempt(self, slot: int, resume_key: np.ndarray) -> Request:
+        """Evict ``slot`` (recompute-on-resume): blocks free, and a
+        continuation request — original prompt extended with every
+        generated token, remaining budget, the live PRNG key — joins
+        the BACK of the queue.  Returns the continuation."""
+        s = self.slots[slot]
+        req = s.request
+        done_tokens = list(req.prior_tokens) + s.emitted
+        remaining = req.max_new_tokens - len(s.emitted)
+        if remaining < 1:
+            raise RuntimeError(
+                f"{req.uid}: preempting a finished slot (bug: retire "
+                f"should have run first)")
+        cont = dataclasses.replace(
+            req,
+            prompt=np.concatenate(
+                [np.asarray(req.prompt, np.int32),
+                 np.asarray(s.emitted, np.int32)]),
+            max_new_tokens=remaining,
+            prior_tokens=tuple(done_tokens),
+            resume_key=np.asarray(resume_key),
+        )
+        self.allocator.free(s.blocks, req)
+        self._clear(slot)
+        self.queue.append(cont)
+        return cont
+
+    def _clear(self, slot: int) -> None:
+        self.slots[slot] = None
+        self.page_table[slot] = TRASH_BLOCK
+        self.lengths[slot] = 0
+        self.last_tok[slot] = 0
+        self.active[slot] = False
+        self.temperature[slot] = 0.0
+        self.top_k[slot] = 0
+        self.top_p[slot] = 1.0
+
+    def idle(self) -> bool:
+        return not self.queue and self.n_active() == 0

@@ -10,11 +10,14 @@ import sys
 REPO = pathlib.Path(__file__).resolve().parents[2]
 sys.path.insert(0, str(REPO / "tools"))
 
+import pytest
+
 import chaos_run  # noqa: E402
 
 from apex_tpu.resilience import validate_incident  # noqa: E402
 
 
+@pytest.mark.slow
 def test_chaos_smoke_nan_storm_plus_truncation(tmp_path):
     out = tmp_path / "INCIDENT_chaos_smoke.json"
     rc = chaos_run.main([

@@ -208,3 +208,82 @@ def test_repo_memlint_validates():
     """The committed MEMLINT artifact is the schema's reference
     instance; it must stay valid."""
     assert gate_hygiene._validate_memlints(str(REPO)) == []
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 6: DECODE_DECOMPOSE_r*.json is gate memory too
+# ---------------------------------------------------------------------------
+
+def _decompose_module(repo):
+    src = REPO / "apex_tpu" / "analysis" / "decode_decompose.py"
+    dst = repo / "apex_tpu" / "analysis"
+    dst.mkdir(parents=True, exist_ok=True)
+    (dst / "decode_decompose.py").write_text(src.read_text())
+
+
+def _valid_decompose(other_frac=0.01):
+    named = (1.0 - other_frac) / 6
+    fr = {k: round(named, 4) for k in
+          ("param_read", "kv_read", "kv_write", "attention",
+           "sampling", "host_sync")}
+    fr["other"] = other_frac
+    total = 1_000_000
+    buckets = {k: int(v * total) for k, v in fr.items()}
+    return {"round": 1, "platform": "cpu",
+            "config": {"batch": 8, "prefill": 2048, "new_tokens": 256},
+            "step_bytes": {"total": sum(buckets.values()),
+                           "buckets": buckets},
+            "device_time_fractions": fr,
+            "coverage": round(1.0 - other_frac, 4)}
+
+
+def test_committed_decompose_validated_against_schema(tmp_repo):
+    _decompose_module(tmp_repo)
+    (tmp_repo / "DECODE_DECOMPOSE_r07_bad.json").write_text(
+        '{"round": 7}')
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "bad decompose")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("DECODE_DECOMPOSE_r07_bad.json" in p
+               for p in verdict["invalid_decomposes"])
+    assert gate_hygiene.main(["--repo", str(tmp_repo)]) == 1
+
+
+def test_decompose_coverage_bar_enforced(tmp_repo):
+    """The >= 90% named-bucket coverage ACCEPTANCE bar is schema-level:
+    a committed decomposition whose 'explanation' is 20% unexplained
+    remainder fails hygiene."""
+    _decompose_module(tmp_repo)
+    (tmp_repo / "DECODE_DECOMPOSE_r08_thin.json").write_text(
+        json.dumps(_valid_decompose(other_frac=0.2)))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "thin decompose")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("coverage" in p for p in verdict["invalid_decomposes"])
+
+
+def test_valid_decompose_passes_schema(tmp_repo):
+    _decompose_module(tmp_repo)
+    (tmp_repo / "DECODE_DECOMPOSE_r09_ok.json").write_text(
+        json.dumps(_valid_decompose()))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "good decompose")
+    assert gate_hygiene.check(str(tmp_repo))["ok"]
+
+
+def test_uncommitted_decompose_artifact_fails(tmp_repo):
+    _decompose_module(tmp_repo)
+    (tmp_repo / "DECODE_DECOMPOSE_r10_new.json").write_text(
+        json.dumps(_valid_decompose()))
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert verdict["untracked"] == ["DECODE_DECOMPOSE_r10_new.json"]
+
+
+def test_repo_decompose_validates():
+    """The committed DECODE_DECOMPOSE artifact is the schema's
+    reference instance; it must stay valid (and over the coverage
+    bar)."""
+    assert gate_hygiene._validate_decomposes(str(REPO)) == []

@@ -415,6 +415,21 @@ def test_cli_strict_mode_memory_budget_enforced(capsys):
         assert rec["ok"], rec
 
 
+def test_cli_serve_lane_dispatch_and_skip(capsys):
+    """``--lanes serve`` dispatches the serve lane through main() —
+    proven cheaply via the policy-pass skip path (no build, no
+    compile; the serve lane linting CLEAN under the full pass matrix
+    is the serve_step entry-point test below)."""
+    import graph_lint
+    assert graph_lint.main(["--families", "mlp", "--passes", "policy",
+                            "--lanes", "o1,serve"]) == 0
+    captured = capsys.readouterr()
+    assert "serve_step" not in captured.out     # skipped, not ok:true
+    assert "skipped: no requested pass applies" in captured.err
+    with pytest.raises(SystemExit):             # typo'd lane refused
+        graph_lint.main(["--lanes", "serv"])
+
+
 def test_cli_memory_budget_violation_fails_exit_code(capsys):
     import graph_lint
     assert graph_lint.main(["--families", "mlp", "--lanes", "o1",
@@ -550,14 +565,17 @@ ENTRY_POINTS = ([_entry_param(f, o)
                  for f in ["mlp", "resnet", "gpt", "bert"]
                  for o in ["O1", "O2"]]
                 + [_entry_param("decode_b1", None),
-                   _entry_param("decode_b2", None)])
+                   _entry_param("decode_b2", None),
+                   _entry_param("serve_step", None)])
 
 
 @pytest.mark.parametrize("name,opt_level", ENTRY_POINTS)
 def test_every_entry_point_lints_clean(name, opt_level):
     import graph_lint
     if opt_level is None:
-        report = graph_lint.lint_decode(
+        lint = graph_lint.lint_serve if name in graph_lint.SERVE_LANES \
+            else graph_lint.lint_decode
+        report = lint(
             name, memory_budget=graph_lint.memory_mod.V5E_HBM_BYTES)
     else:
         report = graph_lint.lint_family(

@@ -5,6 +5,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from apex_tpu.utils import (
     annotate,
@@ -50,6 +51,7 @@ def test_annotate_decorator():
     assert my_fn.__name__ == "my_fn"
 
 
+@pytest.mark.slow
 def test_profiler_capture(tmp_path):
     logdir = str(tmp_path / "trace")
     profiler_start(logdir)

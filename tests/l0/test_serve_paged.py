@@ -1,0 +1,202 @@
+"""Paged KV cache invariants (:mod:`apex_tpu.serve.paged`).
+
+Three contracts: the host-side block allocator's bookkeeping can never
+lose or double-book a block; the page-table indirection is pure data
+movement (gather-linearized contents BITWISE match a monolithic cache
+fed the same token stream, and attention through it matches the
+monolithic decode math bitwise); and slot reuse after retirement leaks
+no stale KV into a new request's attention (the masking test).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.serve import paged
+from apex_tpu.serve.paged import (
+    TRASH_BLOCK,
+    BlockAllocator,
+    PoolExhausted,
+    gather_slot_kv,
+    make_pools,
+    paged_attention,
+    token_write_coords,
+)
+
+L, H, D, BS, MB = 2, 2, 8, 4, 4     # layers, heads, head_dim, block, blocks/slot
+M = MB * BS                          # per-slot context
+
+
+# ---------------------------------------------------------------------------
+# allocator
+# ---------------------------------------------------------------------------
+
+def test_allocator_reserves_trash_and_accounts():
+    a = BlockAllocator(8)
+    assert a.free_count == 7                       # block 0 reserved
+    got = a.alloc(3, "r0")
+    assert TRASH_BLOCK not in got
+    assert len(set(got)) == 3
+    assert a.free_count == 4 and a.live_count == 3
+    a.free(got, "r0")
+    assert a.free_count == 7 and a.live_count == 0
+
+
+def test_allocator_exhaustion_allocates_nothing():
+    a = BlockAllocator(4)
+    a.alloc(2, "r0")
+    with pytest.raises(PoolExhausted):
+        a.alloc(2, "r1")
+    # the failed alloc must not have leaked partial blocks
+    assert a.free_count == 1
+    a.alloc(1, "r1")
+
+
+def test_allocator_double_free_and_cross_owner_rejected():
+    a = BlockAllocator(8)
+    b0 = a.alloc(2, "r0")
+    b1 = a.alloc(2, "r1")
+    a.free(b0, "r0")
+    with pytest.raises(ValueError, match="double free|not owned"):
+        a.free(b0, "r0")
+    with pytest.raises(ValueError, match="not owned"):
+        a.free(b1, "r0")
+    # the rejected call must not have half-released r1's blocks
+    assert sorted(a.owned_by("r1")) == sorted(b1)
+
+
+def test_allocator_rejects_degenerate_pool():
+    with pytest.raises(ValueError):
+        BlockAllocator(1)
+
+
+# ---------------------------------------------------------------------------
+# page-table indirection == monolithic cache, bitwise
+# ---------------------------------------------------------------------------
+
+def _random_stream(rng, n_slots, lengths):
+    """Per-slot per-position K values shaped like one layer's writes."""
+    return [rng.standard_normal((lengths[s], H, D)).astype(np.float32)
+            for s in range(n_slots)]
+
+
+def test_gather_bitwise_matches_monolithic_cache():
+    """Write an interleaved multi-slot token stream through page
+    tables (slot 1's blocks deliberately out of order and interleaved
+    with slot 0's), then gather: contents equal the monolithic
+    ``(S, M, H, D)`` cache fed the same stream, bit for bit."""
+    rng = np.random.default_rng(0)
+    n_slots = 2
+    lengths = [9, 6]
+    stream = _random_stream(rng, n_slots, lengths)
+
+    kc, _ = make_pools(1, 9, BS, H, D, jnp.float32)
+    # non-contiguous physical layout: logical order != physical order
+    table = np.array([[1, 3, 5, TRASH_BLOCK],
+                      [4, 2, TRASH_BLOCK, TRASH_BLOCK]], np.int32)
+    mono = np.zeros((n_slots, M, H, D), np.float32)
+
+    pool = kc[0]
+    for s in range(n_slots):
+        for t in range(lengths[s]):
+            blocks, offs = token_write_coords(
+                jnp.asarray([t], jnp.int32),
+                jnp.asarray(table[s][None]), BS,
+                jnp.asarray([True]))
+            pool = pool.at[blocks[0], offs[0]].set(stream[s][t])
+            mono[s, t] = stream[s][t]
+    lin = gather_slot_kv(pool, jnp.asarray(table))
+    got = np.asarray(lin)
+    # every written position identical; unwritten positions are only
+    # compared where the page table maps real blocks
+    for s in range(n_slots):
+        np.testing.assert_array_equal(got[s, :lengths[s]],
+                                      mono[s, :lengths[s]])
+
+
+def test_paged_attention_bitwise_matches_monolithic_math():
+    """Attention through the gathered cache equals the monolithic
+    decode einsum (:func:`apex_tpu.models.generate._attn_cached`)
+    bitwise on the same contents and mask."""
+    from apex_tpu.models.generate import _attn_cached
+    rng = np.random.default_rng(1)
+    n_slots, t = 2, 10
+    kc, vc = make_pools(1, 9, BS, H, D, jnp.float32)
+    table = np.array([[2, 1, 4, TRASH_BLOCK],
+                      [3, 5, TRASH_BLOCK, TRASH_BLOCK]], np.int32)
+    kpool, vpool = kc[0], vc[0]
+    for s in range(n_slots):
+        for pos in range(t):
+            blocks, offs = token_write_coords(
+                jnp.asarray([pos], jnp.int32),
+                jnp.asarray(table[s][None]), BS, jnp.asarray([True]))
+            kpool = kpool.at[blocks[0], offs[0]].set(
+                rng.standard_normal((H, D)).astype(np.float32))
+            vpool = vpool.at[blocks[0], offs[0]].set(
+                rng.standard_normal((H, D)).astype(np.float32))
+    k_lin = gather_slot_kv(kpool, jnp.asarray(table))
+    v_lin = gather_slot_kv(vpool, jnp.asarray(table))
+    q = jnp.asarray(rng.standard_normal((n_slots, 1, H, D)),
+                    jnp.float32)
+    valid = jnp.broadcast_to(jnp.arange(M) <= (t - 1),
+                             (n_slots, 1, M))
+    got = paged_attention(q, k_lin, v_lin, valid,
+                          scale=1.0 / D ** 0.5)
+    want = _attn_cached(q, k_lin, v_lin,
+                        valid_mask=(jnp.arange(M) <= (t - 1))[None],
+                        scale=1.0 / D ** 0.5)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_slot_reuse_leaks_no_stale_kv():
+    """Retire a long request, hand its physical blocks to a SHORTER
+    one without zeroing: attention over the reused (stale-tailed)
+    blocks must equal attention over a fresh zeroed pool bitwise — the
+    validity mask, not buffer hygiene, is the isolation boundary."""
+    rng = np.random.default_rng(2)
+    scale = 1.0 / D ** 0.5
+    table = jnp.asarray([[1, 2, TRASH_BLOCK, TRASH_BLOCK]], np.int32)
+
+    def run(kpool, vpool, new_len):
+        k_lin = gather_slot_kv(kpool, table)
+        v_lin = gather_slot_kv(vpool, table)
+        q = jnp.asarray(np.linspace(-1, 1, 1 * 1 * H * D,
+                                    dtype=np.float32).reshape(1, 1, H, D))
+        valid = (jnp.arange(M)[None, :] <= (new_len - 1))[None]  # (1,1,M)
+        return paged_attention(q, k_lin, v_lin, valid, scale)
+
+    new_writes_k = rng.standard_normal((3, H, D)).astype(np.float32)
+    new_writes_v = rng.standard_normal((3, H, D)).astype(np.float32)
+
+    def fill(kpool, vpool):
+        for pos in range(3):
+            blocks, offs = token_write_coords(
+                jnp.asarray([pos], jnp.int32), table, BS,
+                jnp.asarray([True]))
+            kpool = kpool.at[blocks[0], offs[0]].set(new_writes_k[pos])
+            vpool = vpool.at[blocks[0], offs[0]].set(new_writes_v[pos])
+        return kpool, vpool
+
+    # stale pool: blocks 1,2 full of a retired request's K/V
+    kc, vc = make_pools(1, 4, BS, H, D, jnp.float32)
+    stale_k = kc[0].at[1:3].set(
+        jnp.asarray(rng.standard_normal((2, BS, H, D)), jnp.float32))
+    stale_v = vc[0].at[1:3].set(
+        jnp.asarray(rng.standard_normal((2, BS, H, D)), jnp.float32))
+    kp, vp = fill(stale_k, stale_v)
+    got = run(kp, vp, 3)
+
+    kc2, vc2 = make_pools(1, 4, BS, H, D, jnp.float32)
+    kp2, vp2 = fill(kc2[0], vc2[0])
+    want = run(kp2, vp2, 3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_token_write_coords_inactive_routes_to_trash():
+    table = jnp.asarray([[3, 4, 5, 6], [7, 8, 1, 2]], np.int32)
+    lengths = jnp.asarray([5, 9], jnp.int32)
+    blocks, offs = token_write_coords(
+        lengths, table, BS, jnp.asarray([True, False]))
+    assert int(blocks[0]) == 4 and int(offs[0]) == 1   # 5 // 4, 5 % 4
+    assert int(blocks[1]) == TRASH_BLOCK               # inactive lane

@@ -506,3 +506,88 @@ def test_check_floor_calibration_fails_loud_on_unimportable_floors(
     broken = bench.check_floor_calibration(str(REPO))
     assert not broken["ok"]
     assert "KERNEL_FLOORS not audited" in broken["error"]
+
+
+# ---------------------------------------------------------------------------
+# Round-6 decode serving: the decode-bandwidth floors and the serve
+# bench (ISSUE 6).
+
+#: Frozen snapshot of the decode hbm_frac floors as committed in round
+#: 6 (the r05 measured values, now that DECODE_DECOMPOSE_r01.json
+#: explains the b8 number) — same erosion rule as every floor table.
+DECODE_FLOOR_SNAPSHOT_R06 = {
+    "gpt_small_tpu_decode_b1": 0.54,
+    "gpt_small_tpu_decode_b8": 0.43,
+}
+
+
+def test_decode_floors_never_erode_without_variance_evidence():
+    variance = bench.load_variance(str(REPO))
+    for name, old in DECODE_FLOOR_SNAPSHOT_R06.items():
+        new = bench.DECODE_FLOORS.get(name)
+        assert new is not None, f"decode floor for {name} deleted"
+        assert bench.floor_change_allowed(name, old, new, variance), (
+            f"{name}: decode floor lowered {old} -> {new} without "
+            "variance evidence")
+
+
+def test_decode_floor_gate():
+    """hbm_frac under floor*(1-band) trips; at/over passes; errored or
+    absent configs are skipped (optional-config semantics); a floor
+    above the roofline ceiling fails loudly."""
+    ok = bench.check_decode_floors(
+        {"gpt_small_tpu_decode_b8": {"hbm_frac": 0.43}})
+    assert ok["ok"] and ok["checked"]["gpt_small_tpu_decode_b8"]["ok"]
+    low = bench.check_decode_floors(
+        {"gpt_small_tpu_decode_b8": {"hbm_frac": 0.39}})
+    assert not low["ok"]
+    assert low["violations"] == ["gpt_small_tpu_decode_b8"]
+    skipped = bench.check_decode_floors(
+        {"gpt_small_tpu_decode_b8": {"error": "OOM"}})
+    assert skipped["ok"] and not skipped["checked"]
+    # hbm_frac of exactly 0.0 is a catastrophic regression, not a
+    # missing value — it must TRIP the gate, never falsy-skip it
+    zero = bench.check_decode_floors(
+        {"gpt_small_tpu_decode_b8": {"hbm_frac": 0.0}})
+    assert not zero["ok"]
+    assert zero["violations"] == ["gpt_small_tpu_decode_b8"]
+    try:
+        bench.DECODE_FLOORS["__impossible"] = 1.2
+        bad = bench.check_decode_floors({})
+        assert not bad["ok"] and "__impossible" in bad["violations"]
+    finally:
+        del bench.DECODE_FLOORS["__impossible"]
+
+
+def test_gate_exit_code_includes_decode_floors():
+    bad = {"ok": True, "mfu_floors": {"ok": True},
+           "decode_floors": {"ok": False,
+                             "violations": ["gpt_small_tpu_decode_b8"]},
+           "ab_failures": []}
+    assert bench.gate_exit_code(bad, compare_given=False) == 2
+    # CPU rounds record no decode gate — never gated on it
+    assert bench.gate_exit_code(
+        {"ok": True, "mfu_floors": None, "decode_floors": None,
+         "ab_failures": []}, compare_given=False) == 0
+
+
+def test_bench_generate_reports_roofline_bound():
+    """The decode ceiling now rides the shared roofline machinery:
+    the record names the binding resource (bandwidth at decode
+    intensity)."""
+    r = bench.bench_generate(batch=2, prefill=16, new_tokens=8,
+                             warmup=0, iters=1, peak=None, tiny=True)
+    assert r["bound"] == "bandwidth"
+    assert r["hbm_tok_s_ceiling"] > 0 and 0 <= r["hbm_frac"]
+
+
+def test_bench_serve_tiny_cpu():
+    """The serve bench path end-to-end on CPU: offered-load sweep
+    c1 -> c_slots, decode-step p50/p99, the latency-tail ab gate, and
+    exactly one decode trace across the whole stream."""
+    r = bench.bench_serve(warmup=1, iters=1, peak=None, tiny=True)
+    assert r["tok_s"] > 0 and r["ab_ok"] is True
+    assert r["p99_ms"] >= r["p50_ms"] > 0
+    levels = r["offered_load"]
+    assert set(levels) == {"c1", "c2"}
+    assert all(v["retraces"] == 1 for v in levels.values())

@@ -125,22 +125,52 @@ def test_prefill_accepts_concrete_zero_start(setup):
                                rtol=1e-5)
 
 
-def test_prefill_still_rejects_nonzero_and_traced_start(setup):
-    """A non-empty cache (start != 0) or a traced start must keep
-    raising: the flash prefill attends only within the chunk."""
-    from apex_tpu.models.generate import _forward_cached
+def _prefill_fixture(setup, m):
     from apex_tpu.models.generate import _stack_layer_params
     cfg, _, params, prompt = setup
     stacked = _stack_layer_params(params, cfg.num_layers)
     top = {k: v for k, v in params.items() if not k.startswith("block_")}
     head_dim = cfg.hidden_size // cfg.num_heads
-    m = L_PROMPT + 4
-    kc = jnp.zeros((cfg.num_layers, B, m, cfg.num_heads, head_dim),
-                   jnp.float32)
-    vc = jnp.zeros_like(kc)
-    with pytest.raises(NotImplementedError, match="non-empty cache"):
-        _forward_cached(top, stacked, cfg, prompt, kc, vc,
-                        start=jnp.int32(2))
-    with pytest.raises(NotImplementedError, match="non-empty cache"):
-        jax.jit(lambda s: _forward_cached(top, stacked, cfg, prompt,
-                                          kc, vc, start=s))(jnp.int32(0))
+
+    def caches():
+        kc = jnp.zeros((cfg.num_layers, B, m, cfg.num_heads, head_dim),
+                       jnp.float32)
+        return kc, jnp.zeros_like(kc)
+
+    return cfg, top, stacked, prompt, caches
+
+
+def test_chunked_prefill_matches_full_prefill_and_decode(setup):
+    """A prompt appended in multi-token chunks at traced mid-sequence
+    ``start`` values (the serve engine's admission path) must land the
+    SAME cache contents bitwise and matching final logits as the
+    one-shot flash prefill — the chunk attends to the cached history
+    plus causally to itself through the einsum path — and the greedy
+    token it implies must equal solo ``generate``'s (mid-stream
+    admission cannot perturb decode)."""
+    from apex_tpu.models.generate import _forward_cached
+    cfg, _, params, prompt = setup
+    m = L_PROMPT + 2
+    _, top, stacked, _, caches = _prefill_fixture(setup, m)
+
+    kc, vc = caches()
+    want, kc_w, vc_w = _forward_cached(top, stacked, cfg, prompt,
+                                       kc, vc, start=0)
+    kc, vc = caches()
+    got = None
+    for j in range(0, L_PROMPT, 4):
+        got, kc, vc = _forward_cached(top, stacked, cfg,
+                                      prompt[:, j:j + 4], kc, vc,
+                                      start=jnp.int32(j))
+    # cache contents are pure data movement + the same per-position
+    # math: bitwise equal.  Logits of the last chunk row go through a
+    # different attention SHAPE (4-row einsum vs full flash prefill),
+    # so they match to fp tolerance, not bitwise.
+    np.testing.assert_array_equal(np.asarray(kc), np.asarray(kc_w))
+    np.testing.assert_array_equal(np.asarray(vc), np.asarray(vc_w))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    first = jnp.argmax(got, axis=-1)
+    solo = generate(params, cfg, prompt, 1)
+    np.testing.assert_array_equal(np.asarray(first),
+                                  np.asarray(solo[:, L_PROMPT]))

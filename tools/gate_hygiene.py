@@ -28,7 +28,12 @@ This check fails (exit 1) when
   platform, half_dtype, non-empty lanes each carrying the verdict,
   finding counts, and the pass's evidence counters) — the
   mixed-precision contract verdict of every O0–O3 lane is gate
-  memory too.
+  memory too, or
+- a committed ``DECODE_DECOMPOSE_r*.json`` does not validate against
+  the decode-decomposition schema
+  (``apex_tpu/analysis/decode_decompose.py``: config, complete bucket
+  table, >= 90% named-bucket coverage) — the explanation of the b8
+  decode gap must stay machine-checked, not prose.
 
 It is wired into tier-1 (``tests/l0/test_gate_hygiene.py``), so a round
 cannot go green with dirty gate memory.  Best-effort on the VCS side:
@@ -60,7 +65,7 @@ REQUIRED = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json")
 PATTERNS = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json",
             "BENCH_VARIANCE.json", "KERNELBENCH_r*.json",
             "BENCH_r*.json", "INCIDENT_r*.json", "MEMLINT_r*.json",
-            "PRECLINT_r*.json")
+            "PRECLINT_r*.json", "DECODE_DECOMPOSE_r*.json")
 
 #: Round-numbered incident artifacts additionally get schema-validated.
 INCIDENT_PATTERN = "INCIDENT_r*.json"
@@ -68,8 +73,11 @@ INCIDENT_PATTERN = "INCIDENT_r*.json"
 #: ... and so do the memory-lint artifacts (graph_lint --emit-json) ...
 MEMLINT_PATTERN = "MEMLINT_r*.json"
 
-#: ... and the precision-lint artifacts.
+#: ... and the precision-lint artifacts ...
 PRECLINT_PATTERN = "PRECLINT_r*.json"
+
+#: ... and the decode-decomposition artifacts.
+DECOMPOSE_PATTERN = "DECODE_DECOMPOSE_r*.json"
 
 
 def _load_by_path(repo: str, *rel: str):
@@ -126,6 +134,22 @@ def _validate_preclints(repo: str) -> "list[str]":
     return problems
 
 
+def _validate_decomposes(repo: str) -> "list[str]":
+    """Schema problems over every present DECODE_DECOMPOSE_r*.json, as
+    ``path: problem`` strings
+    (``apex_tpu/analysis/decode_decompose.py`` — which also enforces
+    the >= 90% named-bucket coverage acceptance bar)."""
+    schema = _load_by_path(repo, "apex_tpu", "analysis",
+                           "decode_decompose.py")
+    if schema is None:
+        return []
+    problems = []
+    for p in sorted(Path(repo).glob(DECOMPOSE_PATTERN)):
+        for msg in schema.validate_decompose_file(str(p)):
+            problems.append(f"{p.name}: {msg}")
+    return problems
+
+
 def _git(repo: str, *args: str) -> "str | None":
     """stdout of a git command, or None when git/The repo is unavailable
     (the best-effort contract)."""
@@ -150,7 +174,8 @@ def check(repo: str = str(REPO)) -> dict:
         return {"ok": True, "skipped": "not a git checkout (or no git): "
                                        "hygiene unverifiable", "missing": [],
                 "untracked": [], "dirty": [], "invalid_incidents": [],
-                "invalid_memlints": [], "invalid_preclints": []}
+                "invalid_memlints": [], "invalid_preclints": [],
+                "invalid_decomposes": []}
     tracked = set(tracked_raw.split())
     missing = [f for f in REQUIRED
                if not (Path(repo) / f).exists() or f not in tracked]
@@ -173,12 +198,14 @@ def check(repo: str = str(REPO)) -> dict:
     invalid = _validate_incidents(repo)
     invalid_mem = _validate_memlints(repo)
     invalid_prec = _validate_preclints(repo)
+    invalid_dec = _validate_decomposes(repo)
     return {"ok": not (missing or untracked or dirty or invalid
-                       or invalid_mem or invalid_prec),
+                       or invalid_mem or invalid_prec or invalid_dec),
             "missing": missing, "untracked": untracked, "dirty": dirty,
             "invalid_incidents": invalid,
             "invalid_memlints": invalid_mem,
-            "invalid_preclints": invalid_prec}
+            "invalid_preclints": invalid_prec,
+            "invalid_decomposes": invalid_dec}
 
 
 def main(argv=None) -> int:
@@ -193,7 +220,9 @@ def main(argv=None) -> int:
               f" modified {verdict['dirty']}; invalid incident records "
               f"{verdict.get('invalid_incidents', [])}; invalid memlint "
               f"records {verdict.get('invalid_memlints', [])}; invalid "
-              f"preclint records {verdict.get('invalid_preclints', [])}",
+              f"preclint records {verdict.get('invalid_preclints', [])}; "
+              f"invalid decode-decompose records "
+              f"{verdict.get('invalid_decomposes', [])}",
               file=sys.stderr)
         return 1
     return 0

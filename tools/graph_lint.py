@@ -17,7 +17,12 @@ Runs every :mod:`apex_tpu.analysis` pass over the four model families
   (``apex_tpu.models.generate._generate_impl``) at bench-shaped tiny
   configs, and ``--emit-json`` additionally lowers the
   ``dryrun_multichip`` slices on the 8-device virtual CPU mesh to
-  record each slice's static per-device HBM.
+  record each slice's static per-device HBM;
+- the **serve lane** lints the continuous-batching engine's compiled
+  decode step (``apex_tpu.serve.ServeEngine``: paged KV pools, page
+  tables, fused sampling epilogue, donated carries) — the serving
+  static-shape contract's static half: no host callback and no
+  retrace hazard on the token loop.
 
 Per-family collective byte budgets are pinned at zero: a single-chip
 train step has no collectives, so ANY appearing is a comm-volume
@@ -49,7 +54,8 @@ writes the committed precision artifact (schema in
 
 Usage:
     python tools/graph_lint.py [--families mlp,gpt] [--passes donation,...]
-                               [--lanes o0,o1,o2,o3,decode] [--no-compile]
+                               [--lanes o0,o1,o2,o3,decode,serve]
+                               [--no-compile]
                                [--memory-budget [BYTES]]
                                [--emit-json MEMLINT_r01.json|PRECLINT_r01.json]
                                [-v]
@@ -112,6 +118,16 @@ FAMILIES = tuple(policy_audit.RAW_CASES)
 #: static analog of the bench's gpt_small_tpu_decode_b{1,8} lanes.
 DECODE_LANES = {"decode_b1": (1, 8, 8), "decode_b2": (2, 8, 8)}
 
+#: serve lanes: (num_slots, block_size, num_blocks, max_blocks_per_slot)
+#: — the continuous-batching engine's compiled decode step
+#: (``apex_tpu.serve.ServeEngine``) at a tiny config.  The lane is the
+#: static half of the serving static-shape contract: the step must
+#: carry no host callback on the token loop and no statically-bound
+#: numeric scalar (either would serialize or retrace the serving
+#: fleet's hot loop); the runtime half (one trace across a whole
+#: admit/retire stream) lives in tests/l0/test_serve_engine.py.
+SERVE_LANES = {"serve_step": (2, 4, 9, 4)}
+
 
 def build_train_step(family: str, raw=None, opt_level: str = "O1"):
     """(jitted_step, example_args, properties): the full train step —
@@ -152,6 +168,58 @@ def build_decode_step(batch: int = 1, prefill: int = 8,
             jax.random.PRNGKey(0))
     kwargs = dict(cfg=cfg, max_new_tokens=new_tokens, sample=False)
     return gen._generate_impl, args, kwargs, a.properties
+
+
+def build_serve_step(num_slots: int = 2, block_size: int = 4,
+                     num_blocks: int = 9, max_blocks_per_slot: int = 4):
+    """(jitted_step, args, properties): the serve engine's compiled
+    continuous-batching decode step at a tiny config — paged KV pools
+    + per-slot page tables + fused sampling epilogue, carries donated —
+    plus the O2 serving policy the params were cast under."""
+    from apex_tpu.models.gpt import GPTModel, gpt_tiny
+    from apex_tpu.serve import ServeConfig, ServeEngine
+
+    cfg = gpt_tiny()
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    a = amp.initialize(opt_level="O2", verbosity=0)
+    params = a.model_params_from(params)
+    scfg = ServeConfig(num_slots=num_slots, block_size=block_size,
+                       num_blocks=num_blocks,
+                       max_blocks_per_slot=max_blocks_per_slot,
+                       prefill_chunk=block_size)
+    eng = ServeEngine(params, cfg, scfg)
+    s = eng.sched
+    args = (eng.top, eng.stacked, eng.carry,
+            jnp.asarray(s.last_tok), jnp.asarray(s.lengths),
+            jnp.asarray(s.active), jnp.asarray(s.page_table),
+            jnp.asarray(s.temperature), jnp.asarray(s.top_k),
+            jnp.asarray(s.top_p))
+    return eng._decode_step, args, a.properties
+
+
+def lint_serve(lane: str, passes=None, compile: bool = True,
+               memory_budget=None, _collect=None):
+    """Lint one serve lane (graph + memlint + precision passes; no
+    policy — the serving step is a bf16 forward by design, like the
+    decode lanes)."""
+    passes = tuple(
+        p for p in (passes or GRAPH_PASSES + MEMLINT_PASSES
+                    + ("precision",))
+        if p != "policy")
+    if not passes:
+        return analysis.Report()
+    slots, bs, nb, mb = SERVE_LANES[lane]
+    fn, args, props = build_serve_step(slots, bs, nb, mb)
+    lowered = analysis.lower_quiet(fn, *args)
+    ctx = analysis.build_context(lowered, compile=compile, policy=props)
+    options = {"collectives": {"budget": {"total": 0}}}
+    options.update(_memlint_options(memory_budget))
+    report = analysis.run_passes(ctx, passes=passes, options=options)
+    if _collect is not None:
+        _collect[lane] = _lane_record(ctx, report)
+    return report
 
 
 def _memlint_options(memory_budget=None):
@@ -347,6 +415,12 @@ def emit_memlint(path: str, families, memory_budget=None,
         n_errors += len(rep.errors)
         if verbose:
             print(f"--- {lane} ---\n{rep.format()}", file=sys.stderr)
+    for lane in SERVE_LANES:
+        rep = lint_serve(lane, memory_budget=memory_budget,
+                         _collect=lanes)
+        n_errors += len(rep.errors)
+        if verbose:
+            print(f"--- {lane} ---\n{rep.format()}", file=sys.stderr)
 
     calibration = _calibration_audit()
     n_errors += sum(1 for f in calibration if f.severity == "error")
@@ -411,6 +485,11 @@ def emit_preclint(path: str, families, verbose: bool = False) -> int:
         lowered = fn.lower(*args, **kwargs)
         ctx = analysis.build_context(lowered, compile=False, policy=props)
         record(lane, ctx)
+    for lane, (slots, bs, nb, mb) in SERVE_LANES.items():
+        fn, args, props = build_serve_step(slots, bs, nb, mb)
+        lowered = analysis.lower_quiet(fn, *args)
+        ctx = analysis.build_context(lowered, compile=False, policy=props)
+        record(lane, ctx)
 
     import numpy as np
     m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
@@ -445,11 +524,12 @@ def main(argv=None) -> int:
     ap.add_argument("--passes", default=",".join(ALL_PASSES),
                     help=f"comma list from {ALL_PASSES}")
     ap.add_argument("--lanes", default=None,
-                    help="comma list from o0,o1,o2,o3,decode (train "
-                         "opt levels + the decode lanes); default "
-                         "o1,decode — except --passes precision, whose "
-                         "contract is the full O0–O3 matrix, where the "
-                         "default is o0,o1,o2,o3,decode")
+                    help="comma list from o0,o1,o2,o3,decode,serve "
+                         "(train opt levels + the decode lanes + the "
+                         "serve-engine step); default o1,decode,serve "
+                         "— except --passes precision, whose contract "
+                         "is the full O0–O3 matrix, where the default "
+                         "is o0,o1,o2,o3,decode,serve")
     ap.add_argument("--no-compile", action="store_true",
                     help="lower only (donation falls back to lowering-"
                          "time aliasing; sharding/collectives/memory/"
@@ -463,10 +543,11 @@ def main(argv=None) -> int:
                     metavar="MEMLINT_rN.json|PRECLINT_rN.json",
                     help="write a committed lint artifact, dispatched "
                          "on the file name: MEMLINT_r*.json = all "
-                         "passes over O1+O2 train + decode + multichip "
-                         "slices + calibration audit; PRECLINT_r*.json "
-                         "= the precision pass over every O0–O3 train "
-                         "lane + decode (lowering only)")
+                         "passes over O1+O2 train + decode + serve + "
+                         "multichip slices + calibration audit; "
+                         "PRECLINT_r*.json = the precision pass over "
+                         "every O0–O3 train lane + decode + serve "
+                         "(lowering only)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print every finding, not just errors")
     opts = ap.parse_args(argv)
@@ -477,19 +558,19 @@ def main(argv=None) -> int:
     if opts.lanes is None:
         # the precision pass's documented contract is the full O0–O3
         # matrix; every other pass combination keeps the historical
-        # o1,decode default
-        opts.lanes = "o0,o1,o2,o3,decode" if passes == ("precision",) \
-            else "o1,decode"
+        # o1,decode default (+ the serve-engine step)
+        opts.lanes = "o0,o1,o2,o3,decode,serve" \
+            if passes == ("precision",) else "o1,decode,serve"
     lanes = [x.strip().lower() for x in opts.lanes.split(",") if x.strip()]
     unknown = [f for f in families if f not in FAMILIES]
     if unknown:
         ap.error(f"unknown families {unknown}; have {FAMILIES}")
     bad_lanes = [x for x in lanes
-                 if x not in TRAIN_LANES + ("decode",)]
+                 if x not in TRAIN_LANES + ("decode", "serve")]
     if bad_lanes or not lanes:
         ap.error(f"unknown lanes {bad_lanes or opts.lanes!r}; have "
-                 f"{', '.join(TRAIN_LANES)}, decode — a typo'd lane "
-                 f"list must not pass the gate by linting nothing")
+                 f"{', '.join(TRAIN_LANES)}, decode, serve — a typo'd "
+                 f"lane list must not pass the gate by linting nothing")
     try:
         budget = parse_bytes(opts.memory_budget) \
             if opts.memory_budget is not None else None
@@ -530,7 +611,8 @@ def main(argv=None) -> int:
                      "family; drop --families")
         if lanes_explicit:
             ap.error("--emit-json PRECLINT_r*.json always writes every "
-                     "lane (O0–O3 train + decode); drop --lanes")
+                     "lane (O0–O3 train + decode + serve); drop "
+                     "--lanes")
         if budget is not None:
             ap.error("--memory-budget does not apply to the precision "
                      "artifact (lowering-only; no compiled memory "
@@ -562,9 +644,9 @@ def main(argv=None) -> int:
                      "--families (a partial lane set would commit a "
                      "schema-valid artifact with most of the HBM "
                      "story silently missing)")
-        if lanes != ["o1", "decode"]:
+        if lanes_explicit:
             ap.error("--emit-json always writes every lane (O1+O2 "
-                     "train, decode, multichip); drop --lanes")
+                     "train, decode, serve, multichip); drop --lanes")
         if budget is None:
             # the artifact's whole point is the asserted per-device
             # budget — a regeneration that forgot --memory-budget
@@ -613,6 +695,11 @@ def main(argv=None) -> int:
     if "decode" in lanes:
         for lane in DECODE_LANES:
             run(lane, lambda ln=lane: lint_decode(
+                ln, passes=passes, compile=not opts.no_compile,
+                memory_budget=budget))
+    if "serve" in lanes:
+        for lane in SERVE_LANES:
+            run(lane, lambda ln=lane: lint_serve(
                 ln, passes=passes, compile=not opts.no_compile,
                 memory_budget=budget))
     if failed:
