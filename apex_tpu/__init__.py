@@ -36,6 +36,10 @@ This package provides the capabilities of NVIDIA Apex (reference:
   on-device sampling epilogue, one compiled step that never retraces
   across admission/retirement (no reference analog — 2019-era apex has
   no inference story at all).
+- :mod:`apex_tpu.obs` — unified runtime telemetry: a lag-resolved
+  metrics registry (zero host syncs on the step path), structured
+  trace spans, and the shared xplane/chrome-trace attribution library
+  every profile tool imports.
 
 Unlike the reference, which monkey-patches eager PyTorch, everything here is
 functional and jit-compiled: loss-scale state is a pytree carried through the
@@ -51,6 +55,7 @@ from apex_tpu import data
 from apex_tpu import fp16_utils
 from apex_tpu import multi_tensor_apply
 from apex_tpu import normalization
+from apex_tpu import obs
 from apex_tpu import optimizers
 from apex_tpu import parallel
 from apex_tpu import resilience
@@ -72,6 +77,7 @@ __all__ = [
     "fp16_utils",
     "multi_tensor_apply",
     "normalization",
+    "obs",
     "optimizers",
     "parallel",
     "resilience",

@@ -1,0 +1,137 @@
+"""DECODE_PROFILE_r*.json — schema for the committed decode-step
+*profile* artifact (the measured counterpart of DECODE_DECOMPOSE).
+
+``tools/profile_decode.py`` writes one of these per round: an xplane
+capture of the exact b8 decode bench program, with measured device
+time bucketed — via :mod:`apex_tpu.obs.xplane` and a classifier built
+from the compiled HLO — into the SAME named buckets the static walk
+(``tools/decode_decompose.py`` / ``DECODE_DECOMPOSE_r01.json``) uses.
+Matching bucket tables are the whole point: the static walk *predicts*
+where the step's time goes (kv_read 0.69, the 709 MB slice-copy
+residual); the profile *measures* it, and the two documents reconcile
+bucket-by-bucket.  The r01 artifact is the CPU-xplane smoke proving
+the capture→bucket pipeline; the on-chip capture that confirms or
+refutes the slice-copy attribution is the next driver round's run of
+the same tool.
+
+Like the other round artifacts this is gate memory:
+``tools/gate_hygiene.py`` validates every committed
+``DECODE_PROFILE_r*.json`` here.  Deliberately **stdlib-only** (no
+jax): gate_hygiene loads it by file path.
+
+Document shape::
+
+    {
+      "round": 1,
+      "platform": "cpu",               # backend of the capture
+      "config": {"batch": 8, "prefill": 2048, "new_tokens": 256,
+                 "model": "gpt_small_tpu"},
+      "method": "xplane-capture",
+      "capture": {"iters": 2, "total_ps": ..., "matched_frac": 0.97,
+                  "source": "xplane-host"},
+      "device_time_ps": {"param_read": ..., ..., "other": ...},
+      "device_time_fractions": {...},  # sum ~ 1
+      "coverage": 0.95,                # 1 - other fraction
+      "decompose_ref": {...},          # optional: the walk's fractions
+      "verdict": "...",
+      "note": "..."
+    }
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+#: the named buckets — MUST equal
+#: ``apex_tpu.analysis.decode_decompose.BUCKETS`` (duplicated here
+#: because gate_hygiene loads each schema module standalone by file
+#: path; ``tests/l0/test_obs.py`` pins the two tuples equal)
+BUCKETS = ("param_read", "kv_read", "kv_write", "attention",
+           "sampling", "host_sync", "other")
+
+
+def validate_profile(doc) -> List[str]:
+    """Problems with one parsed DECODE_PROFILE document (empty =
+    valid)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not a JSON object"]
+    if not isinstance(doc.get("round"), int):
+        problems.append("missing/invalid 'round' (int)")
+    if not isinstance(doc.get("platform"), str):
+        problems.append("missing/invalid 'platform' (str)")
+    cfg = doc.get("config")
+    if not isinstance(cfg, dict) or not all(
+            isinstance(cfg.get(k), int)
+            for k in ("batch", "prefill", "new_tokens")):
+        problems.append("missing/invalid 'config' "
+                        "(batch/prefill/new_tokens ints)")
+    if not isinstance(doc.get("method"), str):
+        problems.append("missing/invalid 'method' (str)")
+
+    cap = doc.get("capture")
+    if not isinstance(cap, dict):
+        problems.append("missing/invalid 'capture' object")
+    else:
+        if not (isinstance(cap.get("iters"), int) and cap["iters"] >= 1):
+            problems.append("capture missing positive int 'iters'")
+        total = cap.get("total_ps")
+        if not (isinstance(total, int) and total > 0):
+            problems.append("capture missing positive 'total_ps' — an "
+                            "empty capture explains nothing")
+        if not isinstance(cap.get("source"), str):
+            problems.append("capture missing 'source' (str)")
+
+    ps = doc.get("device_time_ps")
+    if not isinstance(ps, dict):
+        problems.append("missing/invalid 'device_time_ps' object")
+    else:
+        for k in BUCKETS:
+            v = ps.get(k)
+            if not isinstance(v, int) or v < 0:
+                problems.append(f"device_time_ps bucket {k!r} missing "
+                                f"or not a non-negative int: {v!r}")
+        extra = set(ps) - set(BUCKETS)
+        if extra:
+            problems.append(
+                f"device_time_ps carries unknown buckets {sorted(extra)}"
+                f" — the profile and the static walk must share one "
+                f"bucket vocabulary")
+
+    fr = doc.get("device_time_fractions")
+    if not isinstance(fr, dict) or not all(
+            isinstance(fr.get(k), (int, float)) for k in BUCKETS):
+        problems.append("missing/invalid 'device_time_fractions' "
+                        "(every bucket)")
+        fr = None
+    else:
+        s = sum(float(fr[k]) for k in BUCKETS)
+        if not 0.95 <= s <= 1.05:
+            problems.append(f"device_time_fractions sum to {s:.4f}, "
+                            f"expected ~1")
+
+    cov = doc.get("coverage")
+    if not isinstance(cov, (int, float)):
+        problems.append("missing/invalid 'coverage' (number)")
+    elif fr is not None:
+        derived = 1.0 - float(fr.get("other", 0.0))
+        if abs(cov - derived) > 0.02:
+            problems.append(f"coverage {cov} inconsistent with "
+                            f"fractions (1 - other = {derived:.4f})")
+
+    if not (isinstance(doc.get("verdict"), str)
+            and doc["verdict"].strip()):
+        problems.append("missing/empty 'verdict' (str) — the profile "
+                        "must state what it confirms or refutes")
+    return problems
+
+
+def validate_profile_file(path: str) -> List[str]:
+    """Problems with one DECODE_PROFILE_r*.json file (empty = valid)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable decode-profile JSON: {e}"]
+    return validate_profile(doc)

@@ -1,0 +1,131 @@
+"""OBS_r*.json — schema for the committed observability artifact.
+
+``tools/obs_report.py`` writes one of these per round: the telemetry
+layer's own acceptance evidence — (a) the measured normal-path
+overhead of instrumenting a train step (bare jitted loop vs the
+``apex_tpu.obs``-instrumented one, min-of-interleaved-reps at the
+bench-smoke scale, the ``tools/chaos_run.py --overhead`` methodology),
+(b) the graph-lint **syncs** verdict over the instrumented serve and
+train lanes (instrumentation must introduce zero host callbacks and
+zero retrace hazards), and (c) a registry export snapshot that pins
+the metric catalog and the JSON export shape.
+
+Like MEMLINT/PRECLINT/INCIDENT records the artifact is gate memory:
+``tools/gate_hygiene.py`` validates every committed ``OBS_r*.json``
+against this schema, and the schema ENFORCES the acceptance bars —
+overhead under :data:`OVERHEAD_BUDGET_PCT` and a clean syncs table —
+so the telemetry layer can never quietly regress into a tax on the
+step path.
+
+This module is deliberately **stdlib-only** (no jax import):
+``gate_hygiene`` loads it directly by file path the same way it loads
+``analysis/memlint.py``.
+
+Document shape::
+
+    {
+      "round": 1,
+      "platform": "cpu",
+      "overhead": {"scale": "bench-smoke", "steps": 40, "reps": 5,
+                   "bare_s": ..., "instrumented_s": ...,
+                   "overhead_pct": 0.4},     # must be <= 1.0
+      "syncs": {"clean": true,               # must be true
+                "lanes": {"serve_step": {"host_callbacks": 0,
+                                         "static_scalars": 0,
+                                         "errors": 0}, ...}},
+      "export": {"metrics": [{"name": ..., "type": "counter", ...}]},
+      "note": "..."
+    }
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+#: acceptance bar: instrumentation overhead on the normal step path
+OVERHEAD_BUDGET_PCT = 1.0
+
+#: instrument kinds the export may carry
+METRIC_TYPES = ("counter", "gauge", "histogram")
+
+#: per-lane syncs counters that must all be zero
+_SYNC_KEYS = ("host_callbacks", "static_scalars", "errors")
+
+
+def validate_obs(doc) -> List[str]:
+    """Problems with one parsed OBS document (empty = valid)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not a JSON object"]
+    if not isinstance(doc.get("round"), int):
+        problems.append("missing/invalid 'round' (int)")
+    if not isinstance(doc.get("platform"), str):
+        problems.append("missing/invalid 'platform' (str)")
+
+    ov = doc.get("overhead")
+    if not isinstance(ov, dict):
+        problems.append("missing/invalid 'overhead' object")
+    else:
+        for key in ("bare_s", "instrumented_s", "overhead_pct"):
+            if not isinstance(ov.get(key), (int, float)):
+                problems.append(f"overhead missing numeric {key!r}")
+        if not (isinstance(ov.get("steps"), int) and ov["steps"] > 0):
+            problems.append("overhead missing positive int 'steps'")
+        pct = ov.get("overhead_pct")
+        if isinstance(pct, (int, float)) and pct > OVERHEAD_BUDGET_PCT:
+            problems.append(
+                f"overhead_pct {pct} over the {OVERHEAD_BUDGET_PCT}% "
+                f"budget — the telemetry layer must stay off the step "
+                f"path")
+
+    sy = doc.get("syncs")
+    if not isinstance(sy, dict):
+        problems.append("missing/invalid 'syncs' object")
+    else:
+        if sy.get("clean") is not True:
+            problems.append("'syncs.clean' must be true — committed "
+                            "observability evidence with a dirty "
+                            "syncs verdict is a contradiction")
+        lanes = sy.get("lanes")
+        if not isinstance(lanes, dict) or not lanes:
+            problems.append("'syncs' missing non-empty 'lanes'")
+        else:
+            for name, lane in lanes.items():
+                if not isinstance(lane, dict):
+                    problems.append(f"syncs lane {name!r} not an object")
+                    continue
+                for key in _SYNC_KEYS:
+                    v = lane.get(key)
+                    if not isinstance(v, int) or v < 0:
+                        problems.append(
+                            f"syncs lane {name!r} missing count {key!r}")
+                    elif v != 0:
+                        problems.append(
+                            f"syncs lane {name!r} has {key}={v} — "
+                            f"instrumentation introduced a hazard")
+
+    ex = doc.get("export")
+    rows = ex.get("metrics") if isinstance(ex, dict) else None
+    if not isinstance(rows, list) or not rows:
+        problems.append("missing/empty 'export.metrics' list")
+    else:
+        for i, row in enumerate(rows):
+            if not (isinstance(row, dict)
+                    and isinstance(row.get("name"), str)
+                    and row.get("type") in METRIC_TYPES):
+                problems.append(
+                    f"export.metrics[{i}] malformed (need name:str, "
+                    f"type in {METRIC_TYPES}): {row!r}")
+                break
+    return problems
+
+
+def validate_obs_file(path: str) -> List[str]:
+    """Problems with one OBS_r*.json file (empty = valid)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable obs JSON: {e}"]
+    return validate_obs(doc)

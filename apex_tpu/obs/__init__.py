@@ -1,0 +1,45 @@
+"""apex_tpu.obs — unified runtime telemetry.
+
+The paper's value proposition is *measured* mixed-precision speed;
+this package is the measuring instrument, shared by every subsystem
+instead of re-implemented inside each:
+
+- :mod:`apex_tpu.obs.metrics` — process-local counters / gauges /
+  fixed-bucket histograms whose device-valued updates resolve with
+  **1-step lag** (zero host syncs on the step path — the resilience
+  loop's trick promoted to the registry contract), with Prometheus-text
+  and JSON export (the committed ``OBS_r01.json`` artifact);
+- :mod:`apex_tpu.obs.spans` — structured, nesting trace spans layered
+  on the :mod:`apex_tpu.utils.profiling` shims: named regions land in
+  the HLO metadata *and* captured xplanes, and span wall-durations
+  feed the registry's histograms;
+- :mod:`apex_tpu.obs.xplane` — the xplane / chrome-trace parsing
+  library (extracted from ``tools/profile_step.py``; all profile
+  tools import it), with device-time aggregation, step markers, and
+  named-bucket attribution for ``tools/profile_decode.py``.
+
+See ``docs/source/observability.rst`` for the metric catalog, the
+lag-resolution contract, and the span naming convention.
+"""
+
+from apex_tpu.obs import xplane
+from apex_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    LATENCY_BUCKETS,
+    Registry,
+    counter,
+    gauge,
+    get_registry,
+    histogram,
+    instrument_step,
+)
+from apex_tpu.obs.spans import current_path, span, traced_span
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "LATENCY_BUCKETS",
+    "counter", "gauge", "histogram", "get_registry", "instrument_step",
+    "span", "current_path", "traced_span",
+    "xplane",
+]

@@ -21,6 +21,12 @@ Schema (the r02 artifact is the reference instance):
 - evidence      (required) — a non-empty list of str/dict entries, either
   top-level ``"evidence"``, nested under ``"incident"``, or any key
   containing ``"evidence"`` (the r02 artifact uses both of the last two);
+- ``metrics``   (optional) — a runtime-telemetry snapshot in the
+  :meth:`apex_tpu.obs.metrics.Registry.snapshot` shape
+  (``{"metrics": [{"name", "type", ...}, ...]}``): what the counters
+  and gauges said when the incident fired.  The resilience loop embeds
+  one automatically; records without it (the r02 wedge predates the
+  obs layer) stay valid;
 - anything else is free-form context (``artifact``, ``summary``,
   ``harness``, ``mitigations_added``, ...).
 """
@@ -77,6 +83,15 @@ def validate_incident(obj: Any) -> List[str]:
                     problems.append(
                         f"evidence[{i}] must be str or object, got "
                         f"{type(entry).__name__}")
+    snap = obj.get("metrics")
+    if snap is not None:
+        rows = snap.get("metrics") if isinstance(snap, dict) else None
+        if not isinstance(rows, list) or not all(
+                isinstance(r, dict) and isinstance(r.get("name"), str)
+                and isinstance(r.get("type"), str) for r in rows):
+            problems.append(
+                "'metrics' present but not a registry snapshot "
+                "({'metrics': [{'name': ..., 'type': ...}, ...]})")
     return problems
 
 

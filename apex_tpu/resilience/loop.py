@@ -46,6 +46,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from apex_tpu.obs import metrics as obs_metrics
 from apex_tpu.resilience import incidents as incidents_lib
 from apex_tpu.resilience.faults import FaultInjector, SimulatedPreemption
 
@@ -111,6 +112,7 @@ def run_resilient(
     manager: Any = None,
     config: Optional[ResilienceConfig] = None,
     injector: Optional[FaultInjector] = None,
+    registry: Optional[obs_metrics.Registry] = None,
 ) -> RunResult:
     """Drive ``step_fn(state, *batch) -> (state, metrics)`` for
     ``num_steps`` with the protections in the module docstring.
@@ -122,6 +124,19 @@ def run_resilient(
     :class:`~apex_tpu.resilience.durable.DurableCheckpointManager`)
     enables on-disk checkpointing and checksum-verified rewind — without
     one, an in-memory host snapshot at the same cadence backs rewind.
+
+    The loop records its runtime telemetry into ``registry`` (default:
+    the shared :data:`apex_tpu.obs.metrics.DEFAULT`): ``train_steps/
+    overflows/rewinds/checkpoints_total`` counters, the ``train_loss``
+    gauge, and ``train_watchdog_margin_s`` (budget minus the observed
+    step wall at resolve time — how close the run sails to the
+    watchdog).  Every update happens at the existing lag-resolved
+    points where the scalars are already host values, so the shared
+    registry adds **zero** host syncs; incident records embed a
+    ``metrics`` snapshot of the resolved state (never a device fetch —
+    a watchdog incident must not block on the very device that hung).
+    Steps you hand here should NOT also be wrapped with
+    :func:`apex_tpu.obs.metrics.instrument_step` (double counting).
 
     On a :class:`~apex_tpu.resilience.faults.SimulatedPreemption` (or a
     real ``KeyboardInterrupt`` that is not the watchdog), in-flight saves
@@ -142,6 +157,20 @@ def run_resilient(
     written_incidents: List[dict] = []
     losses: List[Tuple[int, float]] = []
 
+    reg = registry if registry is not None else obs_metrics.DEFAULT
+    m_steps = reg.counter("train_steps_total",
+                          "train steps resolved (1-step lag)")
+    m_over = reg.counter("train_overflows_total",
+                         "loss-scale overflow skips")
+    m_rewinds = reg.counter("train_rewinds_total",
+                            "divergence rewinds executed")
+    m_ckpts = reg.counter("train_checkpoints_total",
+                          "checkpoints committed (or snapshotted)")
+    m_loss = reg.gauge("train_loss", "last resolved loss (1-step lag)")
+    m_margin = reg.gauge(
+        "train_watchdog_margin_s",
+        "watchdog budget minus observed step wall at resolve")
+
     # -- watchdog ---------------------------------------------------------
     inflight: Dict[int, float] = {}
     lock = threading.Lock()
@@ -154,6 +183,10 @@ def run_resilient(
     def _write_incident(status: str, summary: str,
                         evidence: List[Any], **extra: Any) -> None:
         try:
+            # embed the RESOLVED metrics state (no flush: a watchdog
+            # incident fires while the device may be wedged — snapshot
+            # must never device_get)
+            extra.setdefault("metrics", reg.snapshot())
             if cfg.incident_path:
                 rec = incidents_lib.write_incident(
                     cfg.incident_path, status, summary, evidence, **extra)
@@ -240,6 +273,7 @@ def run_resilient(
                 mem_snapshot = (step_i,
                                 ("tree", jax.tree.map(np.asarray, st)))
         events.append({"event": "checkpoint", "step": step_i})
+        m_ckpts.inc()
 
     def _rewind(st: Any, reason: str) -> Tuple[Any, int]:
         nonlocal rewinds, consecutive_pinned
@@ -283,6 +317,7 @@ def run_resilient(
         new_state = _reinit_scaler(new_state)
         events.append({"event": "rewind", "to_step": restored,
                        "reason": reason, "rewind_count": rewinds})
+        m_rewinds.inc()
         return new_state, restored + 1
 
     # -- main loop --------------------------------------------------------
@@ -306,9 +341,18 @@ def run_resilient(
         overflow = bool(np.any(np.asarray(overflow)))
         pinned = bool(np.any(np.asarray(pinned)))
         with lock:
-            inflight.pop(j, None)
+            t0 = inflight.pop(j, None)
         losses.append((j, loss))
         steps_completed = max(steps_completed, j + 1)
+        # shared-registry telemetry: every value here is already a host
+        # scalar at this (lag-resolved) point — zero added syncs
+        m_steps.inc()
+        m_loss.set(loss)
+        if overflow:
+            m_over.inc()
+        if t0 is not None:
+            m_margin.set(cfg.watchdog_timeout_s
+                         - (time.monotonic() - t0))
         if overflow and pinned:
             consecutive_pinned += 1
         else:

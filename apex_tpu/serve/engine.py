@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -49,6 +50,8 @@ from apex_tpu.models.generate import (
     _ln,
     _stack_layer_params,
 )
+from apex_tpu.obs import metrics as obs_metrics
+from apex_tpu.obs import spans
 from apex_tpu.models.gpt import GPTConfig
 from apex_tpu.ops.rope import apply_rope, rope_tables
 from apex_tpu.serve import paged, sampling
@@ -133,14 +136,34 @@ class ServeEngine:
     live loop); ``run()`` drains queue and slots.
     """
 
-    def __init__(self, params, cfg: GPTConfig, serve_cfg: ServeConfig):
+    def __init__(self, params, cfg: GPTConfig, serve_cfg: ServeConfig,
+                 registry: Optional[obs_metrics.Registry] = None):
         self.cfg = cfg
         self.scfg = serve_cfg
+        #: telemetry (apex_tpu.obs) — shared with the scheduler; every
+        #: update is host-side bookkeeping at a step boundary, and the
+        #: step-latency observation times a dispatch+fetch the host
+        #: performs anyway (the (S,) sampled tokens must be streamed),
+        #: so instrumentation adds no host sync to the compiled step
+        self.metrics = registry if registry is not None \
+            else obs_metrics.DEFAULT
+        self._m_step_s = self.metrics.histogram(
+            "serve_decode_step_seconds",
+            "wall seconds per decode step (dispatch + token fetch); "
+            "p50/p99 via Histogram.quantile — bench and serve share "
+            "this percentile math")
+        self._m_tokens = self.metrics.counter(
+            "serve_tokens_total", "tokens generated (active slots x "
+            "decode steps + prefill first-tokens)")
+        self._m_prefill = self.metrics.counter(
+            "serve_prefill_chunks_total",
+            "fixed-size prefill chunks dispatched")
         self.sched = SlotScheduler(
             num_slots=serve_cfg.num_slots,
             num_blocks=serve_cfg.num_blocks,
             block_size=serve_cfg.block_size,
-            max_blocks_per_slot=serve_cfg.max_blocks_per_slot)
+            max_blocks_per_slot=serve_cfg.max_blocks_per_slot,
+            registry=self.metrics)
         self.stacked = _stack_layer_params(params, cfg.num_layers)
         self.top = {k: v for k, v in params.items()
                     if not k.startswith("block_") and k != "layers"}
@@ -172,8 +195,19 @@ class ServeEngine:
     def _decode_body(self, top, stacked, carry, tokens, lengths, active,
                      page_table, temp, top_k, top_p):
         """One continuous-batching decode step over every slot; returns
-        ``(carry', sampled (S,))``."""
+        ``(carry', sampled (S,))``.  The body runs under a trace span:
+        inside tracing that contributes HLO metadata only (the
+        ``serve/decode_step`` scope names every op in captured
+        xplanes), never a host callback — the graph-lint serve lane
+        lints this instrumented program."""
         self.trace_counts["decode"] += 1
+        with spans.span("serve/decode_step", registry=self.metrics):
+            return self._decode_math(top, stacked, carry, tokens,
+                                     lengths, active, page_table, temp,
+                                     top_k, top_p)
+
+    def _decode_math(self, top, stacked, carry, tokens, lengths, active,
+                     page_table, temp, top_k, top_p):
         c = self.cfg
         bs = self.scfg.block_size
         kc, vc, keys = carry["kc"], carry["vc"], carry["keys"]
@@ -218,6 +252,12 @@ class ServeEngine:
         ``n_valid`` are padding: their cache writes route to the trash
         block and their outputs are never read."""
         self.trace_counts["prefill"] += 1
+        with spans.span("serve/prefill_chunk", registry=self.metrics):
+            return self._prefill_math(top, stacked, kc, vc, table_row,
+                                      chunk_ids, start, n_valid)
+
+    def _prefill_math(self, top, stacked, kc, vc, table_row, chunk_ids,
+                      start, n_valid):
         c = self.cfg
         bs = self.scfg.block_size
         mb = self.scfg.max_blocks_per_slot
@@ -273,6 +313,7 @@ class ServeEngine:
                 self.top, self.stacked, kc, vc, table_row,
                 jnp.asarray(padded[None, j:j + c]),
                 jnp.int32(j), jnp.int32(n_valid))
+            self._m_prefill.inc()
         if req.resume_key is not None:
             key = jnp.asarray(req.resume_key, jnp.uint32)[None]
         else:
@@ -285,6 +326,7 @@ class ServeEngine:
         keys = self.carry["keys"].at[slot].set(new_key[0])
         self.carry = {"kc": kc, "vc": vc, "keys": keys}
         self.sched.arm(slot, int(np.asarray(tok)[0]), n)
+        self._m_tokens.inc(1)          # the prefill's sampled token
         # a 1-token budget (or an immediate EOS) finishes on the
         # prefill sample itself — retire before the slot wastes a
         # decode step past its budget
@@ -316,6 +358,8 @@ class ServeEngine:
         sched = self.sched
         if not sched.active.any():
             return {}
+        n_act = int(sched.active.sum())
+        t0 = time.perf_counter()
         self.carry, toks = self._decode_step(
             self.top, self.stacked, self.carry,
             jnp.asarray(sched.last_tok), jnp.asarray(sched.lengths),
@@ -323,6 +367,10 @@ class ServeEngine:
             jnp.asarray(sched.temperature), jnp.asarray(sched.top_k),
             jnp.asarray(sched.top_p))
         toks = np.asarray(toks)
+        # dispatch + the (S,) token fetch the host needs anyway — the
+        # decode-step latency the serve bench gates p50/p99 on
+        self._m_step_s.observe(time.perf_counter() - t0)
+        self._m_tokens.inc(n_act)
         finished: Dict[str, np.ndarray] = {}
         for slot in range(sched.num_slots):
             if not sched.active[slot]:

@@ -40,6 +40,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from apex_tpu.obs import metrics as obs_metrics
 from apex_tpu.serve.paged import BlockAllocator, PoolExhausted, TRASH_BLOCK
 
 
@@ -81,7 +82,8 @@ class SlotScheduler:
     class plans."""
 
     def __init__(self, num_slots: int, num_blocks: int, block_size: int,
-                 max_blocks_per_slot: int):
+                 max_blocks_per_slot: int,
+                 registry: Optional[obs_metrics.Registry] = None):
         if num_slots < 1:
             raise ValueError(f"num_slots={num_slots}")
         self.num_slots = num_slots
@@ -101,6 +103,34 @@ class SlotScheduler:
         self.temperature = np.zeros(num_slots, np.float32)
         self.top_k = np.zeros(num_slots, np.int32)
         self.top_p = np.ones(num_slots, np.float32)
+        # -- telemetry (apex_tpu.obs): every count below is a host-side
+        # bookkeeping update at a step boundary — never on the compiled
+        # step path.  A continuation re-admission counts as an
+        # admission again (total admissions = submissions + preemptions).
+        reg = registry if registry is not None else obs_metrics.DEFAULT
+        self.metrics = reg
+        self._m_admit = reg.counter(
+            "serve_admissions_total", "requests installed into a slot "
+            "(continuation re-admissions included)")
+        self._m_retire = reg.counter(
+            "serve_retirements_total", "requests finished and freed")
+        self._m_preempt = reg.counter(
+            "serve_preemptions_total",
+            "evictions (recompute-on-resume continuations queued)")
+        self._m_queue = reg.gauge("serve_queue_depth",
+                                  "requests waiting for a slot")
+        self._m_occ = reg.gauge("serve_slot_occupancy",
+                                "active slots / num_slots")
+        self._m_blocks = reg.gauge(
+            "serve_block_utilization",
+            "live KV blocks / usable pool (trash block excluded)")
+        self._update_gauges()
+
+    def _update_gauges(self) -> None:
+        self._m_queue.set(float(len(self.queue)))
+        self._m_occ.set(self.n_active() / self.num_slots)
+        usable = max(self.allocator.num_blocks - 1, 1)
+        self._m_blocks.set(self.allocator.live_count / usable)
 
     # -- queue side ----------------------------------------------------
 
@@ -128,6 +158,7 @@ class SlotScheduler:
                 f"{req.uid}: needs {self.blocks_needed(req)} blocks, "
                 f"pool has {self.allocator.num_blocks - 1} usable")
         self.queue.append(req)
+        self._m_queue.set(float(len(self.queue)))
 
     # -- step-boundary planning ---------------------------------------
 
@@ -195,6 +226,8 @@ class SlotScheduler:
         self.temperature[slot] = req.temperature
         self.top_k[slot] = req.top_k
         self.top_p[slot] = req.top_p
+        self._m_admit.inc()
+        self._update_gauges()
 
     # -- engine callbacks ---------------------------------------------
 
@@ -225,6 +258,8 @@ class SlotScheduler:
         s = self.slots[slot]
         self.allocator.free(s.blocks, s.request)
         self._clear(slot)
+        self._m_retire.inc()
+        self._update_gauges()
         toks = list(s.request.prior_tokens) + s.emitted
         return s.request.uid, np.asarray(toks, np.int32)
 
@@ -253,6 +288,8 @@ class SlotScheduler:
         self.allocator.free(s.blocks, req)
         self._clear(slot)
         self.queue.append(cont)
+        self._m_preempt.inc()
+        self._update_gauges()
         return cont
 
     def _clear(self, slot: int) -> None:

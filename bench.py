@@ -559,18 +559,24 @@ def bench_serve(warmup: int, iters: int, peak: float,
     epilogue).
 
     Per level: ``tok_s`` (generated tokens / wall), per-DECODE-STEP
-    wall latency ``p50_ms``/``p99_ms``.  The headline record carries
-    the full-load numbers (``tok_s`` rides the existing delta/ladder
-    gates).  ``ab_ok`` is the latency-tail gate: p99 under
-    ``20 x p50`` — the tail a mid-serve retrace or host sync produces
-    is 100-1000x, so this catches the static-shape contract breaking
-    at runtime without guessing an absolute latency bar before a
-    chip round records one."""
+    wall latency ``p50_ms``/``p99_ms`` — read from the engine's own
+    ``serve_decode_step_seconds`` histogram
+    (:mod:`apex_tpu.obs.metrics`), NOT a private list sort, so bench
+    and a production scrape can never disagree on percentile math (the
+    quantiles are bucket-interpolated the Prometheus way).  The
+    headline record carries the full-load numbers (``tok_s`` rides the
+    existing delta/ladder gates).  ``ab_ok`` is the latency-tail gate:
+    p99 under ``20 x p50`` — the tail a mid-serve retrace or host sync
+    produces is 100-1000x (far beyond bucket-interpolation error), so
+    this catches the static-shape contract breaking at runtime without
+    guessing an absolute latency bar before a chip round records
+    one."""
     del peak, warmup
     import numpy as np
 
     from apex_tpu import amp
     from apex_tpu.models.gpt import GPTModel, gpt_small_tpu, gpt_tiny
+    from apex_tpu.obs.metrics import Registry
     from apex_tpu.serve import Request, ServeConfig, ServeEngine
 
     cfg = gpt_tiny() if tiny else gpt_small_tpu()
@@ -602,35 +608,39 @@ def bench_serve(warmup: int, iters: int, peak: float,
 
     # ONE engine serves every load level: the decode/prefill programs
     # compile once (each ServeEngine re-jits, and the compile dominates
-    # setup on chip), and the retraces==1 gate then spans the sweep
-    eng = ServeEngine(params, cfg, scfg)
+    # setup on chip), and the retraces==1 gate then spans the sweep.
+    # A PRIVATE registry isolates the histogram from any other serving
+    # in this process; per-level windows come from histogram snapshots.
+    eng = ServeEngine(params, cfg, scfg, registry=Registry())
+    step_hist = eng.metrics.histogram("serve_decode_step_seconds")
+    tok_counter = eng.metrics.counter("serve_tokens_total")
 
     def drive(n, tag):
         for r in make_reqs(n, tag):
             eng.submit(r)
         eng.step()                       # admission + compile + 1 step
-        step_ms, produced = [], 0
+        mark = step_hist.state()         # window: steady-state steps
+        tok0 = tok_counter.value
         t0 = time.perf_counter()
         while not eng.sched.idle():
-            # admission/prefill is driven OUTSIDE the timed window of
-            # the step sample: p50/p99 are DECODE-step latency (the
-            # retrace/host-sync tail this gate watches), while
-            # admission cost still lands in the wall-clock tok_s
+            # admission/prefill is driven OUTSIDE the decode-step
+            # sample the engine histogram records: p50/p99 are
+            # DECODE-step latency (the retrace/host-sync tail this
+            # gate watches), while admission cost still lands in the
+            # wall-clock tok_s
             eng._admit_and_evict()
             if not eng.sched.active.any():
                 raise RuntimeError("serve bench admission stall: "
                                    "queued requests but no active slot")
-            s0 = time.perf_counter()
-            active = int(eng.sched.active.sum())
             eng.step()
-            step_ms.append((time.perf_counter() - s0) * 1e3)
-            produced += active
         wall = time.perf_counter() - t0
-        step_ms = np.asarray(step_ms) if step_ms else np.asarray([0.0])
+        produced = tok_counter.value - tok0
+        steps = step_hist.count - mark[2]
+        p50 = step_hist.quantile(0.5, since=mark) * 1e3 if steps else 0.0
+        p99 = step_hist.quantile(0.99, since=mark) * 1e3 if steps else 0.0
         return {"tok_s": round(produced / wall, 2) if wall else 0.0,
-                "p50_ms": round(float(np.percentile(step_ms, 50)), 3),
-                "p99_ms": round(float(np.percentile(step_ms, 99)), 3),
-                "steps": len(step_ms), "retraces":
+                "p50_ms": round(p50, 3), "p99_ms": round(p99, 3),
+                "steps": int(steps), "retraces":
                     eng.trace_counts["decode"]}
 
     del iters  # the request stream sets the sample count

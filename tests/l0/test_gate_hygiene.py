@@ -287,3 +287,79 @@ def test_repo_decompose_validates():
     reference instance; it must stay valid (and over the coverage
     bar)."""
     assert gate_hygiene._validate_decomposes(str(REPO)) == []
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7: OBS_r*.json and DECODE_PROFILE_r*.json are gate memory too
+# ---------------------------------------------------------------------------
+
+def _analysis_module(repo, stem):
+    src = REPO / "apex_tpu" / "analysis" / f"{stem}.py"
+    dst = repo / "apex_tpu" / "analysis"
+    dst.mkdir(parents=True, exist_ok=True)
+    (dst / f"{stem}.py").write_text(src.read_text())
+
+
+def _valid_obs(overhead_pct=0.4):
+    return {"round": 1, "platform": "cpu",
+            "overhead": {"steps": 40, "bare_s": 0.5,
+                         "instrumented_s": 0.5,
+                         "overhead_pct": overhead_pct},
+            "syncs": {"clean": True,
+                      "lanes": {"serve_step": {"host_callbacks": 0,
+                                               "static_scalars": 0,
+                                               "errors": 0}}},
+            "export": {"metrics": [{"name": "x", "type": "counter"}]}}
+
+
+def test_committed_obs_validated_against_schema(tmp_repo):
+    _analysis_module(tmp_repo, "obs")
+    (tmp_repo / "OBS_r07_bad.json").write_text('{"round": 7}')
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "bad obs")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("OBS_r07_bad.json" in p for p in verdict["invalid_obs"])
+    assert gate_hygiene.main(["--repo", str(tmp_repo)]) == 1
+
+
+def test_obs_overhead_budget_bar_enforced(tmp_repo):
+    """The <1% instrumentation-overhead ACCEPTANCE bar is
+    schema-level: a committed OBS record over budget fails hygiene."""
+    _analysis_module(tmp_repo, "obs")
+    (tmp_repo / "OBS_r08_slow.json").write_text(
+        json.dumps(_valid_obs(overhead_pct=1.8)))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "slow obs")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("budget" in p for p in verdict["invalid_obs"])
+
+
+def test_valid_obs_passes_and_untracked_fails(tmp_repo):
+    _analysis_module(tmp_repo, "obs")
+    (tmp_repo / "OBS_r09_ok.json").write_text(json.dumps(_valid_obs()))
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert verdict["untracked"] == ["OBS_r09_ok.json"]
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "good obs")
+    assert gate_hygiene.check(str(tmp_repo))["ok"]
+
+
+def test_committed_profile_validated_against_schema(tmp_repo):
+    _analysis_module(tmp_repo, "decode_profile")
+    (tmp_repo / "DECODE_PROFILE_r07_bad.json").write_text('{"round": 7}')
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "bad profile")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("DECODE_PROFILE_r07_bad.json" in p
+               for p in verdict["invalid_profiles"])
+
+
+def test_repo_obs_and_profile_validate():
+    """The committed OBS_r01 / DECODE_PROFILE_r01 artifacts are the
+    schemas' reference instances; they must stay valid."""
+    assert gate_hygiene._validate_obs(str(REPO)) == []
+    assert gate_hygiene._validate_profiles(str(REPO)) == []

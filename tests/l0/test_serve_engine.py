@@ -18,6 +18,7 @@ import pytest
 from apex_tpu import amp, analysis
 from apex_tpu.models import GPTModel, gpt_tiny
 from apex_tpu.models.generate import generate
+from apex_tpu.obs.metrics import Registry
 from apex_tpu.serve import Request, ServeConfig, ServeEngine
 from apex_tpu.serve.sampling import sample_tokens
 
@@ -45,7 +46,9 @@ def engine(setup):
     cfg, params, _ = setup
     scfg = ServeConfig(num_slots=2, block_size=4, num_blocks=17,
                        max_blocks_per_slot=8, prefill_chunk=4)
-    return ServeEngine(params, cfg, scfg)
+    # a private registry: the metric assertions below count THIS
+    # engine's scripted history, not whatever else the process served
+    return ServeEngine(params, cfg, scfg, registry=Registry())
 
 
 def _solo(params, cfg, prompt, n):
@@ -73,6 +76,21 @@ def test_mixed_stream_matches_solo_and_never_retraces(setup, engine):
     assert eng.trace_counts == {"decode": 1, "prefill": 1, "sample1": 1}
     assert eng._decode_step._cache_size() == 1
     assert eng._prefill_chunk._cache_size() == 1
+    # telemetry (apex_tpu.obs): the counters match the scripted
+    # stream — 5 admissions, 5 retirements, no preemption, every
+    # generated token counted, and the decode-step histogram observed
+    # every step (this is the histogram bench.py reads p50/p99 from)
+    m = eng.metrics
+    assert m.counter("serve_admissions_total").value == 5
+    assert m.counter("serve_retirements_total").value == 5
+    assert m.counter("serve_preemptions_total").value == 0
+    assert m.counter("serve_tokens_total").value == sum(news)
+    h = m.histogram("serve_decode_step_seconds")
+    assert h.count > 0 and h.quantile(0.5) > 0
+    # drained: gauges back to idle
+    assert m.gauge("serve_queue_depth").value == 0
+    assert m.gauge("serve_slot_occupancy").value == 0
+    assert m.gauge("serve_block_utilization").value == 0
 
 
 def test_decode_step_has_no_host_sync_or_retrace_hazard(setup):
@@ -106,7 +124,7 @@ def test_preemption_recompute_preserves_outputs(setup):
     cfg, params, prompts = setup
     scfg = ServeConfig(num_slots=3, block_size=4, num_blocks=9,
                        max_blocks_per_slot=8, prefill_chunk=4)
-    eng = ServeEngine(params, cfg, scfg)
+    eng = ServeEngine(params, cfg, scfg, registry=Registry())
     preempts = []
     orig = eng.sched.preempt
     eng.sched.preempt = lambda slot, key: (preempts.append(slot),
@@ -121,6 +139,13 @@ def test_preemption_recompute_preserves_outputs(setup):
                                       _solo(params, cfg, p, n))
     # pool bookkeeping drained clean
     assert eng.sched.allocator.live_count == 0
+    # telemetry: 3 fresh admissions + 1 continuation re-admission,
+    # exactly one preemption, 3 retirements (the preempted request
+    # retires once, under its own uid)
+    m = eng.metrics
+    assert m.counter("serve_admissions_total").value == 4
+    assert m.counter("serve_preemptions_total").value == 1
+    assert m.counter("serve_retirements_total").value == 3
 
 
 def test_submit_validation():

@@ -69,7 +69,7 @@ def main():
     seq = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
 
     import bench
-    from profile_step import parse_xplane
+    from apex_tpu.obs.xplane import parse_xplane
 
     peak = bench.chip_peak_flops()
     iters = 8

@@ -258,7 +258,7 @@ def main():
         jax.block_until_ready(st)
     time.sleep(1)
 
-    from profile_step import parse_xplane
+    from apex_tpu.obs.xplane import parse_xplane
     by_name, _, total = parse_xplane(logdir)
 
     rows = []

@@ -33,7 +33,17 @@ This check fails (exit 1) when
   the decode-decomposition schema
   (``apex_tpu/analysis/decode_decompose.py``: config, complete bucket
   table, >= 90% named-bucket coverage) — the explanation of the b8
-  decode gap must stay machine-checked, not prose.
+  decode gap must stay machine-checked, not prose, or
+- a committed ``OBS_r*.json`` does not validate against the
+  observability schema (``apex_tpu/analysis/obs.py``: instrumentation
+  overhead under the 1% budget, a clean syncs table over the
+  instrumented lanes, a non-empty metric-catalog export) — the
+  telemetry layer's own cost is gate memory too, or
+- a committed ``DECODE_PROFILE_r*.json`` does not validate against the
+  decode-profile schema (``apex_tpu/analysis/decode_profile.py``:
+  capture provenance, the DECODE_DECOMPOSE bucket vocabulary, a
+  stated verdict) — the measured half of the decode decomposition
+  stays machine-checked like the static half.
 
 It is wired into tier-1 (``tests/l0/test_gate_hygiene.py``), so a round
 cannot go green with dirty gate memory.  Best-effort on the VCS side:
@@ -65,7 +75,8 @@ REQUIRED = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json")
 PATTERNS = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json",
             "BENCH_VARIANCE.json", "KERNELBENCH_r*.json",
             "BENCH_r*.json", "INCIDENT_r*.json", "MEMLINT_r*.json",
-            "PRECLINT_r*.json", "DECODE_DECOMPOSE_r*.json")
+            "PRECLINT_r*.json", "DECODE_DECOMPOSE_r*.json",
+            "OBS_r*.json", "DECODE_PROFILE_r*.json")
 
 #: Round-numbered incident artifacts additionally get schema-validated.
 INCIDENT_PATTERN = "INCIDENT_r*.json"
@@ -76,8 +87,14 @@ MEMLINT_PATTERN = "MEMLINT_r*.json"
 #: ... and the precision-lint artifacts ...
 PRECLINT_PATTERN = "PRECLINT_r*.json"
 
-#: ... and the decode-decomposition artifacts.
+#: ... and the decode-decomposition artifacts ...
 DECOMPOSE_PATTERN = "DECODE_DECOMPOSE_r*.json"
+
+#: ... and the observability artifacts ...
+OBS_PATTERN = "OBS_r*.json"
+
+#: ... and the measured decode-profile artifacts.
+PROFILE_PATTERN = "DECODE_PROFILE_r*.json"
 
 
 def _load_by_path(repo: str, *rel: str):
@@ -150,6 +167,35 @@ def _validate_decomposes(repo: str) -> "list[str]":
     return problems
 
 
+def _validate_obs(repo: str) -> "list[str]":
+    """Schema problems over every present OBS_r*.json, as
+    ``path: problem`` strings (``apex_tpu/analysis/obs.py`` — which
+    also enforces the <1% overhead budget and the clean-syncs bar)."""
+    schema = _load_by_path(repo, "apex_tpu", "analysis", "obs.py")
+    if schema is None:
+        return []
+    problems = []
+    for p in sorted(Path(repo).glob(OBS_PATTERN)):
+        for msg in schema.validate_obs_file(str(p)):
+            problems.append(f"{p.name}: {msg}")
+    return problems
+
+
+def _validate_profiles(repo: str) -> "list[str]":
+    """Schema problems over every present DECODE_PROFILE_r*.json, as
+    ``path: problem`` strings
+    (``apex_tpu/analysis/decode_profile.py``)."""
+    schema = _load_by_path(repo, "apex_tpu", "analysis",
+                           "decode_profile.py")
+    if schema is None:
+        return []
+    problems = []
+    for p in sorted(Path(repo).glob(PROFILE_PATTERN)):
+        for msg in schema.validate_profile_file(str(p)):
+            problems.append(f"{p.name}: {msg}")
+    return problems
+
+
 def _git(repo: str, *args: str) -> "str | None":
     """stdout of a git command, or None when git/The repo is unavailable
     (the best-effort contract)."""
@@ -175,7 +221,8 @@ def check(repo: str = str(REPO)) -> dict:
                                        "hygiene unverifiable", "missing": [],
                 "untracked": [], "dirty": [], "invalid_incidents": [],
                 "invalid_memlints": [], "invalid_preclints": [],
-                "invalid_decomposes": []}
+                "invalid_decomposes": [], "invalid_obs": [],
+                "invalid_profiles": []}
     tracked = set(tracked_raw.split())
     missing = [f for f in REQUIRED
                if not (Path(repo) / f).exists() or f not in tracked]
@@ -199,13 +246,18 @@ def check(repo: str = str(REPO)) -> dict:
     invalid_mem = _validate_memlints(repo)
     invalid_prec = _validate_preclints(repo)
     invalid_dec = _validate_decomposes(repo)
+    invalid_obs = _validate_obs(repo)
+    invalid_prof = _validate_profiles(repo)
     return {"ok": not (missing or untracked or dirty or invalid
-                       or invalid_mem or invalid_prec or invalid_dec),
+                       or invalid_mem or invalid_prec or invalid_dec
+                       or invalid_obs or invalid_prof),
             "missing": missing, "untracked": untracked, "dirty": dirty,
             "invalid_incidents": invalid,
             "invalid_memlints": invalid_mem,
             "invalid_preclints": invalid_prec,
-            "invalid_decomposes": invalid_dec}
+            "invalid_decomposes": invalid_dec,
+            "invalid_obs": invalid_obs,
+            "invalid_profiles": invalid_prof}
 
 
 def main(argv=None) -> int:
@@ -222,7 +274,10 @@ def main(argv=None) -> int:
               f"records {verdict.get('invalid_memlints', [])}; invalid "
               f"preclint records {verdict.get('invalid_preclints', [])}; "
               f"invalid decode-decompose records "
-              f"{verdict.get('invalid_decomposes', [])}",
+              f"{verdict.get('invalid_decomposes', [])}; invalid obs "
+              f"records {verdict.get('invalid_obs', [])}; invalid "
+              f"decode-profile records "
+              f"{verdict.get('invalid_profiles', [])}",
               file=sys.stderr)
         return 1
     return 0

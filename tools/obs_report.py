@@ -1,0 +1,282 @@
+"""Measure the telemetry layer's cost and emit the OBS_r*.json artifact.
+
+The observability layer's acceptance criteria are themselves
+observability claims, so they get the same treatment as every other
+gate in this repo: measured, machine-checked, committed.  This tool
+produces ``OBS_r*.json`` (schema: ``apex_tpu/analysis/obs.py``,
+enforced on committed copies by ``tools/gate_hygiene.py``) with three
+sections:
+
+- **overhead** — wall time of a bare jitted train loop vs the same
+  loop wrapped with :func:`apex_tpu.obs.metrics.instrument_step`
+  (per-step dispatch histogram + counters + lag-deferred loss/overflow
+  resolution), at the bench-smoke scale with the
+  ``tools/chaos_run.py --overhead`` methodology (interleaved reps,
+  min-to-min — the standard noise-robust wall-clock estimator).  The
+  schema enforces the < 1% budget;
+- **syncs** — the graph-lint ``syncs`` pass over the instrumented
+  lanes (the serve engine's compiled decode step, which carries the
+  ``serve/decode_step`` span, and the mlp O1/O2 train steps): zero
+  host callbacks, zero static-scalar retrace hazards, zero errors.
+  Instrumentation that costs a sync would fail here before it could
+  be committed;
+- **export** — a registry snapshot after an instrumented train + serve
+  sample: pins the metric catalog and the JSON export shape reviewers
+  and scrapers rely on.
+
+Usage::
+
+    python tools/obs_report.py --emit OBS_r01.json
+    python tools/obs_report.py --quick          # fast smoke (tests)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+import graph_lint  # noqa: E402  (sibling tool: sets platform/flags, lanes)
+
+import jax  # noqa: E402
+
+from apex_tpu.obs import metrics as obs_metrics  # noqa: E402
+
+import chaos_run  # noqa: E402  (sibling tool: shared workload builder)
+
+
+def measure_overhead(steps: int = 40, reps: int = 5, seed: int = 0,
+                     calls: int = 2000) -> dict:
+    """Instrumentation overhead at the CPU bench-smoke scale.
+
+    The **gated number** (``overhead_pct``) is a deterministic
+    decomposition: the per-call host cost of the full
+    :func:`~apex_tpu.obs.metrics.instrument_step` path — dispatch
+    histogram, counters, the deferred loss/overflow records, and the
+    batched lag resolution fetching real device scalars — microbenched
+    over ``calls`` invocations against a precomputed step output,
+    divided by the measured bare step time.  On this class of shared
+    2-vCPU host, end-to-end wall clock swings ±5-10% rep to rep
+    (recorded below as ``bare_spread_s``), so a <1% budget can only
+    be checked against a measurement whose own noise is well under
+    1%; the microbench is exact to microseconds.
+
+    The end-to-end comparison (order-balanced interleaved reps,
+    min-to-min — the ``tools/chaos_run.py --overhead`` methodology) is
+    still run and recorded as ``wall_check``: it bounds the true cost
+    from above within the host's noise and would catch a pathological
+    regression (an accidental per-step sync shows up as +50-500%, far
+    over any noise)."""
+    amp_obj, step_fn, state0, batch_fn = chaos_run.build_workload(
+        seed, features=(256, 256), batch=256, d_in=256)
+    del amp_obj
+    batch = batch_fn(0)
+
+    def bare():
+        st = state0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            st, m = step_fn(st, *batch)
+        jax.block_until_ready(m["loss"])
+        return time.perf_counter() - t0
+
+    def instrumented():
+        reg = obs_metrics.Registry()
+        wrapped = obs_metrics.instrument_step(step_fn, registry=reg,
+                                              name="train")
+        st = state0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            st, m = wrapped(st, *batch)
+        jax.block_until_ready(m["loss"])
+        reg.flush()
+        return time.perf_counter() - t0
+
+    bare(); instrumented()        # compile outside the timed region
+    import gc
+    bare_ts, inst_ts = [], []
+    for rep in range(reps):
+        # balanced order + a collected heap per rep: a fixed
+        # bare-first order would bill GC pressure and noise epochs to
+        # whichever loop runs second
+        gc.collect()
+        if rep % 2 == 0:
+            bare_ts.append(bare())
+            inst_ts.append(instrumented())
+        else:
+            inst_ts.append(instrumented())
+            bare_ts.append(bare())
+    bare_t, inst_t = min(bare_ts), min(inst_ts)
+
+    # -- the deterministic per-step instrumentation cost --------------
+    out = step_fn(state0, *batch)
+    jax.block_until_ready(out[1]["loss"])
+
+    def precomputed_step(st, *a):
+        return out
+
+    reg = obs_metrics.Registry()
+    wrapped = obs_metrics.instrument_step(precomputed_step,
+                                          registry=reg, name="train")
+    wrapped(state0, *batch)       # instrument creation outside timing
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        wrapped(state0, *batch)
+    reg.flush()
+    inst_us = (time.perf_counter() - t0) / calls * 1e6
+
+    bare_ms = bare_t / steps * 1e3
+    return {
+        "scale": "bench-smoke (MLP 256x256, batch 256, amp O2)",
+        "method": "per-step instrument path microbenched over "
+                  f"{calls} calls (incl. batched lag resolution of "
+                  "device scalars) / measured bare step time; wall "
+                  "check: order-balanced interleaved reps, min-to-min",
+        "steps": steps, "reps": reps,
+        "bare_s": round(bare_t, 4),
+        "instrumented_s": round(inst_t, 4),
+        "bare_spread_s": [round(t, 4) for t in sorted(bare_ts)],
+        "bare_ms_per_step": round(bare_ms, 3),
+        "instrument_us_per_step": round(inst_us, 3),
+        "overhead_pct": round(100.0 * inst_us / (bare_ms * 1e3), 3),
+        "wall_check": {
+            "instrumented_ms_per_step": round(inst_t / steps * 1e3, 3),
+            "wall_overhead_pct": round(
+                100.0 * (inst_t - bare_t) / bare_t, 3),
+            "note": "noise-bounded upper check, not the gated number "
+                    "(host wall spread exceeds the 1% budget)"},
+    }
+
+
+def syncs_evidence(include_trains: bool = True) -> dict:
+    """The graph-lint ``syncs`` pass over the INSTRUMENTED lanes: the
+    serve engine's compiled decode step (span-carrying body) and the
+    mlp O1/O2 train lanes.  Returns the OBS ``syncs`` section."""
+    lanes = {}
+
+    def record(name, report):
+        syncs = report.by_pass("syncs")
+        lanes[name] = {
+            "host_callbacks": sum(1 for f in syncs
+                                  if f.op == "host-callback"),
+            "static_scalars": sum(1 for f in syncs
+                                  if f.op == "static-scalar"),
+            "errors": len(report.errors),
+            "findings": len(syncs),
+        }
+
+    record("serve_step",
+           graph_lint.lint_serve("serve_step", passes=("syncs",)))
+    if include_trains:
+        for opt_level in ("O1", "O2"):
+            record(f"mlp_{opt_level.lower()}_train",
+                   graph_lint.lint_family("mlp", passes=("syncs",),
+                                          opt_level=opt_level))
+    clean = all(v["host_callbacks"] == 0 and v["static_scalars"] == 0
+                and v["errors"] == 0 for v in lanes.values())
+    return {"clean": bool(clean), "lanes": lanes,
+            "pass": "analysis/syncs.py (host callbacks, infeed/"
+                    "outfeed, static-scalar retrace hazards)"}
+
+
+def export_sample(quick: bool = False) -> dict:
+    """Populate a fresh registry with an instrumented train + serve
+    sample and export it — the committed metric-catalog snapshot."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu import amp
+    from apex_tpu.models.gpt import GPTModel, gpt_tiny
+    from apex_tpu.serve import Request, ServeConfig, ServeEngine
+
+    reg = obs_metrics.Registry()
+    # train sample: a few instrumented steps (tiny workload)
+    _, step_fn, state, batch_fn = chaos_run.build_workload(0)
+    wrapped = obs_metrics.instrument_step(step_fn, registry=reg)
+    for i in range(4):
+        state, _m = wrapped(state, *batch_fn(i))
+    reg.flush()
+
+    # serve sample: a short mixed stream through a tiny engine
+    cfg = gpt_tiny()
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    a = amp.initialize(opt_level="O2", verbosity=0)
+    params = a.model_params_from(params)
+    scfg = ServeConfig(num_slots=2, block_size=4, num_blocks=9,
+                       max_blocks_per_slot=4, prefill_chunk=4)
+    eng = ServeEngine(params, cfg, scfg, registry=reg)
+    rng = np.random.RandomState(0)
+    n_req = 2 if quick else 3
+    for i in range(n_req):
+        eng.submit(Request(uid=f"s{i}",
+                           prompt=rng.randint(0, cfg.vocab_size, (5,)),
+                           max_new_tokens=4))
+    eng.run()
+    reg.flush()
+    return reg.snapshot()
+
+
+def build_doc(steps: int, reps: int, quick: bool) -> dict:
+    return {
+        "round": 1,
+        "platform": jax.devices()[0].platform,
+        "overhead": measure_overhead(steps=steps, reps=reps),
+        "syncs": syncs_evidence(include_trains=not quick),
+        "export": export_sample(quick=quick),
+        "note": (
+            "Telemetry-layer acceptance evidence: instrumentation "
+            "overhead under the 1% budget (schema-enforced), the "
+            "syncs pass clean over the instrumented serve + train "
+            "lanes (schema-enforced), and the registry export "
+            "snapshot pinning the metric catalog.  Regenerate with "
+            "tools/obs_report.py --emit OBS_rN.json on a quiet host."),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--quick", action="store_true",
+                    help="smaller everything (smoke/tests); not for "
+                         "committed artifacts")
+    ap.add_argument("--emit", default=None, metavar="OBS_rN.json",
+                    help="write the committed artifact (validated "
+                         "against apex_tpu/analysis/obs.py; refuses "
+                         "an invalid document)")
+    opts = ap.parse_args(argv)
+    if opts.quick:
+        opts.steps, opts.reps = 20, 2
+
+    doc = build_doc(opts.steps, opts.reps, opts.quick)
+    if opts.emit:
+        m = re.search(r"_r(\d+)\.json$", os.path.basename(opts.emit))
+        if m:
+            doc["round"] = int(m.group(1))
+        from apex_tpu.analysis import obs as schema
+        problems = schema.validate_obs(doc)
+        if problems:
+            print(f"refusing to write {opts.emit}: {problems}",
+                  file=sys.stderr)
+            return 1
+        with open(opts.emit, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"obs artifact written: {opts.emit}", file=sys.stderr)
+    else:
+        print(json.dumps(doc, indent=1, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

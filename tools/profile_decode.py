@@ -1,0 +1,363 @@
+"""Profile the decode bench program and bucket measured device time
+into the DECODE_DECOMPOSE named buckets.
+
+``tools/decode_decompose.py`` *predicted* where the b8 decode step's
+time goes by walking the lowered StableHLO (kv_read 0.69 of the ideal
+step, plus a 709 MB residual matching the per-layer KV slice-copy
+materialization).  This tool closes the loop with a MEASUREMENT: it
+runs the exact same bench program (``generate._generate_impl`` at
+gpt_small_tpu b8 — same lowering entry, same shapes), captures an
+XProf trace, aggregates op-level device time through
+:mod:`apex_tpu.obs.xplane`, and classifies every instruction of the
+decode loop's while-body into the same seven buckets via a classifier
+built from the compiled HLO (operand/result shape markers: the cache
+pool, cache-slice materializations, the vocab dimension, the context
+length).
+
+Scope discipline: only instructions belonging to the decode while-loop
+body (transitively through called computations) are bucketed — the
+prefill forward and host/infra time are reported separately as
+``non_step_ps`` so the bucket table stays comparable to the static
+walk's per-token step.
+
+On **CPU** (this environment; the tier-1 smoke) the capture has no
+device plane — the xplane library harvests the host XLA executor
+lines; times are thread-summed and say nothing about HBM, so the
+artifact's verdict is explicitly "pipeline smoke".  On a **TPU** the
+same invocation measures real device time and the verdict compares
+the measured ``kv_read``/slice-copy share against the walk's 709 MB
+residual attribution — the next driver round's one-command job:
+
+    python tools/profile_decode.py --emit DECODE_PROFILE_r02.json
+
+The emitted ``DECODE_PROFILE_r*.json`` is validated against
+``apex_tpu/analysis/decode_profile.py`` (stdlib-only; gate hygiene
+enforces it on committed copies) and refuses to write an invalid
+document.
+
+Usage:
+    python tools/profile_decode.py [--batch 8] [--prefill 2048]
+        [--new-tokens 256] [--tiny] [--iters 2]
+        [--emit DECODE_PROFILE_rN.json] [--logdir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import shutil
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+os.environ.setdefault("APEX_TPU_KERNELS", "jnp")
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+jax.config.update("jax_platforms",
+                  os.environ.get("APEX_TPU_TEST_PLATFORM", "cpu"))
+
+import decode_decompose  # noqa: E402  (sibling tool: shared lowering)
+from apex_tpu.analysis.decode_profile import BUCKETS  # noqa: E402
+from apex_tpu.obs import xplane  # noqa: E402
+
+_DEF_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+) = (.*)$")
+_CALLS_RE = re.compile(
+    r"(?:calls|body|condition|to_apply|branch_computations)="
+    r"[{(]?%?([\w.\-]+)")
+_CALLBACKS = ("python_cpu_callback", "python_gpu_callback",
+              "python_tpu_callback", "tpu_host_callback", "infeed",
+              "outfeed")
+
+
+def _computations(hlo: str) -> dict:
+    """``{computation name: [body lines]}`` of an HLO text dump."""
+    comps: dict = {}
+    cur = None
+    for raw in hlo.splitlines():
+        s = raw.strip()
+        if s.endswith("{") and " = " not in s and "(" in s:
+            cur = s.split()[0].lstrip("%").split("(")[0]
+            comps[cur] = []
+        elif cur is not None:
+            comps[cur].append(raw)
+            if s == "}":
+                cur = None
+    return comps
+
+
+def _closure(comps: dict, roots) -> set:
+    """Computation names reachable from ``roots`` through
+    calls/body/condition/to_apply references."""
+    seen = set()
+    work = list(roots)
+    while work:
+        name = work.pop()
+        if name in seen or name not in comps:
+            continue
+        seen.add(name)
+        for raw in comps[name]:
+            for m in _CALLS_RE.finditer(raw):
+                work.append(m.group(1))
+    return seen
+
+
+class StepClassifier:
+    """instruction name -> bucket, for the decode while-body's
+    instructions, built from the compiled HLO text.
+
+    Shape markers (HLO type strings like ``bf16[12,8,2304,4,64]``):
+    the full cache pool ``(L,B,M,H,D)``, a cache-slice
+    materialization ``(B,M,H,D)`` (the DECODE_DECOMPOSE residual
+    candidate — tracked separately as ``slice_copy`` evidence), the
+    vocab dimension, and the context length M.  Classification mirrors
+    the static walk's conventions: ops reading the cache feed
+    ``kv_read``; cache writes ``kv_write``; weight-operand dots and
+    the embedding gather ``param_read``; vocab-shaped non-dot ops
+    ``sampling``; M-length score-chain tensors ``attention``."""
+
+    def __init__(self, hlo: str, cfg, batch: int, m_ctx: int):
+        L, H = cfg.num_layers, cfg.num_heads
+        D = cfg.hidden_size // cfg.num_heads
+        V = cfg.vocab_size
+        self.cache_full = f"[{L},{batch},{m_ctx},{H},{D}]"
+        self.cache_slices = (f"[{batch},{m_ctx},{H},{D}]",
+                             f"[1,{batch},{m_ctx},{H},{D}]")
+        self.vocab_marks = (f",{V}]", f"[{V},")
+        self.m_marks = (f",{m_ctx},", f",{m_ctx}]")
+        comps = _computations(hlo)
+        # the decode loop = while bodies whose closure touches the
+        # cache pool (prefill has no full-pool operand)
+        bodies = []
+        for lines in comps.values():
+            for raw in lines:
+                if " while(" not in raw:
+                    continue
+                bm = re.search(r"body=%?([\w.\-]+)", raw)
+                if bm:
+                    bodies.append(bm.group(1))
+        step_comps = set()
+        for body in bodies:
+            cl = _closure(comps, [body])
+            if any(self.cache_full in raw
+                   for c in cl for raw in comps.get(c, [])):
+                step_comps |= cl
+        if not step_comps:
+            raise RuntimeError(
+                "no while body touching the KV cache pool "
+                f"{self.cache_full} found — the compiled layout "
+                "changed; update StepClassifier")
+        self.buckets: dict = {}
+        self.slice_copy_ops: set = set()
+        for cname in step_comps:
+            for raw in comps[cname]:
+                m = _DEF_RE.match(raw)
+                if not m:
+                    continue
+                name, rest = m.groups()
+                text = rest
+                cm = re.search(r"calls=%?([\w.\-]+)", rest)
+                if cm and cm.group(1) in comps:
+                    text = rest + "\n" + "\n".join(comps[cm.group(1)])
+                self.buckets[name] = self._bucket(name, rest, text)
+
+    def _bucket(self, name: str, defline: str, text: str):
+        if any(cb in text for cb in _CALLBACKS):
+            return "host_sync"
+        if "dynamic-update-slice" in text and self.cache_full in text:
+            return "kv_write"
+        cacheish = self.cache_full in text or \
+            any(cs in text for cs in self.cache_slices)
+        dot = re.search(r"\bdot\(", text) is not None
+        if cacheish:
+            result_type = defline.split(" ")[0]
+            if not dot and any(cs in result_type
+                               for cs in self.cache_slices):
+                # a materialized cache-slice-shaped RESULT with no
+                # consuming dot in the same fusion: the slice-copy
+                # candidate the walk's residual points at
+                self.slice_copy_ops.add(name)
+            return "kv_read"
+        if dot or "convolution(" in text:
+            return "param_read"
+        if any(vm in text for vm in self.vocab_marks):
+            if "gather(" in text:
+                return "param_read"          # embedding-row gather
+            return "sampling"
+        if any(mm in text for mm in self.m_marks):
+            return "attention"
+        return None                          # -> "other"
+
+    def step_ops(self) -> set:
+        return set(self.buckets)
+
+    def __call__(self, name: str):
+        return self.buckets.get(name)
+
+
+def build_and_run(batch: int, prefill: int, new_tokens: int,
+                  tiny: bool, iters: int, logdir: str):
+    """Lower/compile the exact bench decode program, run ``iters``
+    captures, return ``(compiled, cfg, capture_source_dir)``."""
+    lowered, cfg = decode_decompose.lower_decode(batch, prefill,
+                                                 new_tokens, tiny=tiny)
+    compiled = lowered.compile()
+    # the lowering came from ShapeDtypeStructs; materialize zero-filled
+    # arrays of those shapes (traffic, not token quality, is measured)
+    in_args, in_kwargs = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype),
+        lowered.args_info,
+        is_leaf=lambda x: hasattr(x, "shape") and hasattr(x, "dtype"))
+    out = compiled(*in_args, **in_kwargs)    # warm run outside capture
+    jax.block_until_ready(out)
+    shutil.rmtree(logdir, ignore_errors=True)   # stale planes would
+    # double-count: the parser aggregates every file under the logdir
+    with jax.profiler.trace(logdir):
+        for _ in range(iters):
+            out = compiled(*in_args, **in_kwargs)
+        jax.block_until_ready(out)
+    time.sleep(1.0)                          # let the trace flush
+    return compiled, cfg
+
+
+def profile(batch: int, prefill: int, new_tokens: int, tiny: bool,
+            iters: int, logdir: str) -> dict:
+    compiled, cfg = build_and_run(batch, prefill, new_tokens, tiny,
+                                  iters, logdir)
+    m_ctx = prefill + new_tokens
+    clf = StepClassifier(compiled.as_text(), cfg, batch, m_ctx)
+    times = xplane.op_times(logdir)
+    step_ops = clf.step_ops()
+    step_times = {n: ps for n, ps in times.by_op.items()
+                  if n in step_ops}
+    non_step_ps = times.total_ps - sum(step_times.values())
+    table = xplane.bucket_op_times(step_times, clf,
+                                   buckets=list(BUCKETS))
+    slice_copy_ps = sum(ps for n, ps in step_times.items()
+                       if n in clf.slice_copy_ops)
+
+    platform = jax.devices()[0].platform
+    fractions = {k: table["fractions"].get(k, 0.0) for k in BUCKETS}
+    coverage = round(1.0 - fractions["other"], 4)
+
+    ref = None
+    ref_path = max(REPO.glob("DECODE_DECOMPOSE_r*.json"), default=None)
+    if ref_path is not None:
+        try:
+            with open(ref_path) as f:
+                ref_doc = json.load(f)
+            ref = {"file": ref_path.name,
+                   "device_time_fractions":
+                       ref_doc.get("device_time_fractions"),
+                   "residual_frac_of_step":
+                       (ref_doc.get("gap_attribution") or {}).get(
+                           "residual_frac_of_step")}
+        except (OSError, ValueError):
+            ref = None
+
+    if platform == "cpu":
+        verdict = (
+            "CPU-xplane smoke: capture -> obs.xplane -> named buckets "
+            "pipeline proven end-to-end on the exact bench decode "
+            "program (thread-summed host-executor times; no HBM "
+            "claim).  The on-chip capture that confirms or refutes "
+            "the kv-slice-copy residual is the next driver round: "
+            "run this tool unchanged on a TPU host with --emit "
+            "DECODE_PROFILE_r02.json")
+    else:
+        kvr = fractions["kv_read"]
+        want = None
+        if ref and ref.get("device_time_fractions"):
+            want = ref["device_time_fractions"].get("kv_read")
+        comp = (f" vs the walk's ideal {want}" if want is not None
+                else "")
+        scf = slice_copy_ps / max(table["total_ps"], 1)
+        verdict = (
+            f"on-chip capture: measured kv_read fraction {kvr}{comp}; "
+            f"materialized cache-slice ops carry {scf:.4f} of the "
+            f"step — "
+            + ("CONFIRMS the slice-copy attribution (residual-scale "
+               "time in materialized cache-slice ops)" if scf >= 0.1
+               else "REFUTES residual-scale slice-copy time; "
+                    "re-attribute the decompose residual"))
+
+    return {
+        "round": 1,
+        "platform": platform,
+        "config": {"batch": batch, "prefill": prefill,
+                   "new_tokens": new_tokens,
+                   "model": "gpt_tiny" if tiny else "gpt_small_tpu"},
+        "method": "xplane-capture",
+        "capture": {"iters": iters, "total_ps": int(times.total_ps),
+                    "step_ps": int(sum(step_times.values())),
+                    "non_step_ps": int(non_step_ps),
+                    "matched_frac": round(
+                        table["matched_ps"]
+                        / max(table["total_ps"], 1), 4),
+                    "source": times.source,
+                    "step_ops_profiled": len(step_times),
+                    "step_ops_known": len(step_ops)},
+        "device_time_ps": {k: int(table["bucket_ps"].get(k, 0))
+                           for k in BUCKETS},
+        "device_time_fractions": fractions,
+        "coverage": coverage,
+        "slice_copy": {"ps": int(slice_copy_ps),
+                       "ops": len(clf.slice_copy_ops)},
+        "decompose_ref": ref,
+        "verdict": verdict,
+        "note": (
+            "Buckets cover ONLY the decode while-body's instructions "
+            "(prefill + infra reported as non_step_ps) so the table "
+            "reconciles bucket-by-bucket with the static walk "
+            "(DECODE_DECOMPOSE).  Classifier: compiled-HLO shape "
+            "markers; fusions classified by their dominant cache/"
+            "weight/vocab content.  CPU captures harvest host XLA "
+            "executor lines (thread-summed)."),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prefill", type=int, default=2048)
+    ap.add_argument("--new-tokens", type=int, default=256)
+    ap.add_argument("--tiny", action="store_true",
+                    help="gpt_tiny config (tests / CPU smoke)")
+    ap.add_argument("--iters", type=int, default=2)
+    ap.add_argument("--logdir", default="/tmp/apex_tpu_profile_decode")
+    ap.add_argument("--emit", default=None,
+                    metavar="DECODE_PROFILE_rN.json",
+                    help="write the committed artifact (validated "
+                         "against apex_tpu/analysis/decode_profile.py; "
+                         "refuses an invalid document)")
+    opts = ap.parse_args(argv)
+
+    doc = profile(opts.batch, opts.prefill, opts.new_tokens, opts.tiny,
+                  opts.iters, opts.logdir)
+    if opts.emit:
+        m = re.search(r"_r(\d+)\.json$", os.path.basename(opts.emit))
+        if m:
+            doc["round"] = int(m.group(1))
+        from apex_tpu.analysis import decode_profile as schema
+        problems = schema.validate_profile(doc)
+        if problems:
+            print(f"refusing to write {opts.emit}: {problems}",
+                  file=sys.stderr)
+            return 1
+        with open(opts.emit, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"decode profile written: {opts.emit}", file=sys.stderr)
+    else:
+        print(json.dumps(doc, indent=1, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -10,8 +10,6 @@ VERDICT r1 item 3.  The chrome-trace JSON export is lossy here (op-level
 events are missing for large programs); the xplane is complete.
 """
 
-import collections
-import glob
 import json
 import sys
 import time
@@ -21,6 +19,11 @@ import jax
 
 REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
+
+# the xplane/chrome-trace walk lives in the obs library now (one
+# parser for every profile tool; behavior pinned by the obs fixture
+# tests) — this tool only drives the capture and prints the table
+from apex_tpu.obs.xplane import parse_xplane  # noqa: E402
 
 
 def build(model_name: str, opt_level: str):
@@ -37,96 +40,6 @@ def build(model_name: str, opt_level: str):
         fn = lambda: bench.bench_resnet(opt_level, batch=256, size=224,
                                         warmup=2, iters=8, peak=peak)
     return fn
-
-
-def parse_trace_json(logdir: str):
-    """Lossy fallback: aggregate the chrome-trace JSON export (op-level
-    events can be missing for large programs — prefer the xplane)."""
-    import gzip
-    by_name = collections.Counter()
-    by_cat = collections.Counter()
-    total = 0
-    for path in glob.glob(f"{logdir}/**/*.trace.json.gz", recursive=True):
-        trace = json.loads(gzip.open(path, "rt").read())
-        events = trace.get("traceEvents", [])
-        # Mirror parse_xplane's filter: only the device planes' "XLA Ops"
-        # line (metadata events map pid -> process/plane name and
-        # (pid, tid) -> thread/line name); counting every complete event
-        # would double-count ops inside step markers and mix in host
-        # threads.
-        proc = {}
-        thread = {}
-        for ev in events:
-            if ev.get("ph") != "M":
-                continue
-            name = ev.get("args", {}).get("name", "")
-            if ev.get("name") == "process_name":
-                proc[ev.get("pid")] = name
-            elif ev.get("name") == "thread_name":
-                thread[(ev.get("pid"), ev.get("tid"))] = name
-        for ev in events:
-            if ev.get("ph") != "X" or "dur" not in ev:
-                continue
-            if not proc.get(ev.get("pid"), "").startswith("/device:"):
-                continue
-            if thread.get((ev.get("pid"), ev.get("tid"))) != "XLA Ops":
-                continue
-            d = int(ev["dur"] * 1e6)            # us -> ps, match xplane
-            by_name[ev.get("name", "?")] += d
-            by_cat[ev.get("args", {}).get("hlo_category", "?")] += d
-            total += d
-    return by_name, by_cat, total
-
-
-def parse_xplane(logdir: str):
-    """Aggregate device-plane op durations from the xplane protobuf.
-    Falls back to the lossy chrome-trace JSON when the tensorflow/tsl
-    xplane proto is not importable (ADVICE r2)."""
-    try:
-        from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    except ImportError as e:
-        print(f"warning: xplane proto unavailable ({e}); falling back to "
-              f"the lossy chrome-trace JSON parser (install tensorflow "
-              f"for the complete tsl xplane protobuf path)",
-              file=sys.stderr)
-        return parse_trace_json(logdir)
-
-    paths = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
-    by_name = collections.Counter()
-    by_cat = collections.Counter()
-    total = 0
-    for path in paths:
-        xs = xplane_pb2.XSpace()
-        xs.ParseFromString(open(path, "rb").read())
-        for plane in xs.planes:
-            if not plane.name.startswith("/device:"):
-                continue
-            emeta, smeta = plane.event_metadata, plane.stat_metadata
-            cat_id = next((k for k, v in smeta.items()
-                           if v.name == "hlo_category"), None)
-            for line in plane.lines:
-                if line.name != "XLA Ops":
-                    continue
-                for ev in line.events:
-                    d = ev.duration_ps
-                    name = emeta[ev.metadata_id].name
-                    # strip the "%op = type{layout} ..." HLO dump down to
-                    # the op name for aggregation
-                    short = name.split(" = ")[0].lstrip("%")
-                    by_name[short] += d
-                    total += d
-                    cat = "?"
-                    for st in list(ev.stats) + \
-                            list(emeta[ev.metadata_id].stats):
-                        if st.metadata_id != cat_id:
-                            continue
-                        which = st.WhichOneof("value")
-                        val = getattr(st, which)
-                        cat = (smeta[val].name if which == "ref_value"
-                               else str(val))
-                        break
-                    by_cat[cat] += d
-    return by_name, by_cat, total
 
 
 def main():
