@@ -28,27 +28,33 @@ from apex_tpu.amp.ops import (
     cast_context,
     disable_casts,
     float_function,
+    fp8_function,
+    fp8_trace,
     half_function,
     promote_function,
     register_float_function,
+    register_fp8_function,
     register_half_function,
     register_promote_function,
 )
-from apex_tpu.amp.policy import DYNAMIC, O0, O1, O2, O3, Properties, opt_levels, resolve
+from apex_tpu.amp.policy import (DYNAMIC, O0, O1, O2, O3, O4, Properties,
+                                 opt_levels, resolve)
 from apex_tpu.amp.scaler import LossScaler, LossScaleState, all_finite
 
 __all__ = [
     "Amp", "AmpState", "initialize", "make_train_step",
     "init", "scale_loss", "active_amp", "AmpHandle", "NoOpHandle",
     "default_keep_fp32_filter",
-    "Properties", "O0", "O1", "O2", "O3", "opt_levels", "resolve", "DYNAMIC",
+    "Properties", "O0", "O1", "O2", "O3", "O4", "opt_levels", "resolve",
+    "DYNAMIC",
     "LossScaler", "LossScaleState", "all_finite",
     "ops", "lists",
     "audit", "audit_text", "format_report",
     "cast_context", "disable_casts",
     "half_function", "float_function", "promote_function", "banned_function",
+    "fp8_function", "fp8_trace",
     "register_half_function", "register_float_function",
-    "register_promote_function",
+    "register_promote_function", "register_fp8_function",
 ]
 
 

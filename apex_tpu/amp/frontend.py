@@ -58,12 +58,22 @@ class AmpState(NamedTuple):
     ``master_params`` is fp32 when master weights are on; otherwise it holds
     the params at model dtype (O0/O1/O3 semantics — the optimizer runs
     directly on them, ``_process_optimizer.py:165-239``).
+
+    ``fp8_state`` is the delayed-scaling state of the O4 fp8 regime
+    (:class:`apex_tpu.quant.fp8.Fp8TrainState`: one amax-history +
+    scale per tensor class) and ``None`` below O4.  It sits next to
+    the loss-scaler states on purpose: both are "how far can this
+    step's values stretch" estimators carried as pure pytree state, so
+    ``apply_gradients``, the resilience rewind path, and
+    ``DurableCheckpointManager`` handle it with no special cases —
+    it's just more leaves.
     """
 
     master_params: Any
     opt_state: Any
     scaler_states: Tuple[LossScaleState, ...]
     step: jax.Array
+    fp8_state: Any = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -85,12 +95,18 @@ class Amp:
         ``_initialize.py:176-177`` requires incoming fp32; we cast to be safe,
         mirroring ``allow_incoming_model_not_fp32`` leniency)."""
         master = self._master_from(params)
+        fp8_state = None
+        if self.properties.enabled and self.properties.fp8:
+            from apex_tpu.quant import fp8 as fp8_lib
+            fp8_state = fp8_lib.init_train_state(
+                self.properties.fp8_amax_history_len)
         return AmpState(
             master_params=master,
             opt_state=self.tx.init(master),
             scaler_states=tuple(self.scaler.init_state()
                                 for _ in range(self.num_losses)),
             step=jnp.zeros((), jnp.int32),
+            fp8_state=fp8_state,
         )
 
     def _master_from(self, params: Any) -> Any:
@@ -193,7 +209,8 @@ class Amp:
 
         fresh = self.tx.init(merged)
         opt_state = jax.tree_util.tree_map_with_path(graft, fresh)
-        return AmpState(merged, opt_state, state.scaler_states, state.step)
+        return AmpState(merged, opt_state, state.scaler_states, state.step,
+                        state.fp8_state)
 
     # ------------------------------------------------------------------
     # model application (reference _initialize.py:197-208 forward patch)
@@ -281,7 +298,7 @@ class Amp:
                                                 state.master_params)
             master = optax.apply_updates(state.master_params, updates)
             return (AmpState(master, opt_state, state.scaler_states,
-                             state.step + 1),
+                             state.step + 1, state.fp8_state),
                     {"overflow": jnp.asarray(False),
                      "loss_scale": jnp.asarray(1.0, jnp.float32),
                      "pinned_at_floor": jnp.asarray(False)})
@@ -367,7 +384,7 @@ class Amp:
             skip, lambda op: op, do_step,
             (state.master_params, state.opt_state))
         return AmpState(master, opt_state, state.scaler_states,
-                        state.step + 1)
+                        state.step + 1, state.fp8_state)
 
     def apply_gradients_multi(
         self,
@@ -556,14 +573,33 @@ def make_train_step(
         params_c = amp.model_params(state)
         if axis_name is not None:
             params_c = pvary_params(params_c, axis_name)
+        fp8_on = amp.properties.enabled and amp.properties.fp8 \
+            and state.fp8_state is not None
 
         def scaled_loss(p, micro):
-            out = amp.run(loss_fn, p, *micro)
+            if fp8_on:
+                # O4: the delayed scales enter (and the per-callsite
+                # forward amaxes leave) through the trace-local fp8
+                # context — all values of THIS trace, so the state
+                # stays purely functional and the collected amaxes
+                # ride the loss aux back out.  The e5m2 cotangent
+                # scale is grad.scale/loss_scale: the rounding point
+                # sees loss-scaled cotangents while the grad history
+                # records unscaled units (stable across scaler moves)
+                eff_gs = state.fp8_state.grad.scale \
+                    / state.scaler_states[0].loss_scale
+                with amp_ops.fp8_trace(state.fp8_state,
+                                       grad_scale=eff_gs) as tr:
+                    out = amp.run(loss_fn, p, *micro)
+                    amaxes = amp_ops.collected_fp8_amaxes(tr)
+            else:
+                out = amp.run(loss_fn, p, *micro)
+                amaxes = None
             loss, aux = out if has_aux else (out, None)
-            return amp.scale_loss(loss, state), (loss, aux)
+            return amp.scale_loss(loss, state), (loss, aux, amaxes)
 
         if accum_steps is None or accum_steps == 1:
-            grads, (loss, aux) = jax.grad(
+            grads, (loss, aux, fp8_amaxes) = jax.grad(
                 lambda p: scaled_loss(p, batch), has_aux=True)(params_c)
         else:
             def split(t):
@@ -581,7 +617,7 @@ def make_train_step(
             micro_batches = jax.tree.map(split, batch)
 
             def body(acc, micro):
-                g, (loss, aux) = jax.grad(
+                g, (loss, aux, amaxes) = jax.grad(
                     lambda p: scaled_loss(p, micro),
                     has_aux=True)(params_c)
                 # accumulate in fp32 regardless of compute dtype: summing
@@ -589,7 +625,7 @@ def make_train_step(
                 # reference accumulates into fp32 master grads)
                 acc = jax.tree.map(
                     lambda a, gi: a + gi.astype(a.dtype), acc, g)
-                return acc, (loss, aux)
+                return acc, (loss, aux, amaxes)
 
             zero = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params_c)
@@ -598,22 +634,55 @@ def make_train_step(
                 # fresh zeros are not — mark them varying so the scan
                 # carry types agree (grads stay per-rank until reduce_fn)
                 zero = pvary_params(zero, axis_name)
-            grads, (losses, auxes) = jax.lax.scan(body, zero,
-                                                  micro_batches)
+            grads, (losses, auxes, fp8_amaxes) = jax.lax.scan(
+                body, zero, micro_batches)
             # mean-loss semantics: the accumulated step equals the
             # large-batch mean-loss step (grads scaled by 1/N; an inf in
             # any micro-batch survives the sum and skips the step)
             grads = jax.tree.map(lambda g: g / accum_steps, grads)
             loss = jnp.mean(losses)
+            if fp8_on:
+                # per-micro amaxes stacked (accum_steps,): the history
+                # entry is the iteration's max, like every other class
+                fp8_amaxes = jax.tree.map(jnp.max, fp8_amaxes)
             # per-micro aux stacked with a leading (accum_steps,) dim —
             # documented; reduce it yourself (e.g. take aux[-1] for
             # carried stats)
             aux = auxes if has_aux else None
 
+        fp8_metrics = {}
+        if fp8_on:
+            # end-of-step history roll (quant.fp8): forward amaxes from
+            # the op layer's collector, grad amax from THIS step's
+            # still-scaled grads (the e5m2 rounding point sees scaled
+            # cotangents, so the delayed grad scale tracks the scaled
+            # magnitude) — everything stays on device, and
+            # apply_gradients below threads the new state through with
+            # no special case (it's just more pytree leaves)
+            from apex_tpu.quant import fp8 as fp8_lib
+            amax_in, amax_w = fp8_amaxes
+            # grads are still loss-scaled here: record the UNSCALED
+            # amax (divide the scale back out) so the grad history is
+            # unit-stable across loss-scale moves — and so the
+            # precision lint's scale-placement dataflow can prove the
+            # returned state carries no scaled value
+            amax_g = fp8_lib.tree_amax(grads) \
+                * (1.0 / state.scaler_states[0].loss_scale)
+            margin = amp.properties.fp8_margin
+            new_fp8 = fp8_lib.update_train_state(
+                state.fp8_state, amax_in, amax_w, amax_g, margin)
+            fp8_metrics = {
+                "fp8_amax_saturation": fp8_lib.step_saturation(
+                    state.fp8_state, amax_in, amax_w, amax_g, margin),
+                "fp8_rescales": fp8_lib.rescale_events(
+                    state.fp8_state, new_fp8),
+            }
+            state = state._replace(fp8_state=new_fp8)
+
         new_state, info = amp.apply_gradients(state, grads,
                                               reduce_fn=reduce_fn,
                                               finite_axes=finite_axes)
-        metrics = {"loss": loss, **info}
+        metrics = {"loss": loss, **info, **fp8_metrics}
         if has_aux:
             metrics["aux"] = aux
         return new_state, metrics

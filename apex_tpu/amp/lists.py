@@ -58,6 +58,33 @@ SEQUENCE_PROMOTE_OPS = ["concatenate", "stack"]  # torch_overrides.py:100-103
 
 BANNED_OPS = ["binary_cross_entropy"]  # functional_overrides.py:67-77
 
+# -- fp8 (O4) lists ---------------------------------------------------------
+# The same shape as the 16-bit tables, one level down: under an fp8
+# policy only the MXU contraction family quantizes its operands to e4m3
+# (f32 accumulation via preferred_element_type); everything in
+# FP8_DENY_OPS keeps its 16-bit/fp32 behavior from the tables above —
+# fp8's 3 (e4m3) or 2 (e5m2) mantissa bits destroy pointwise
+# transcendentals, normalization statistics, and reductions outright
+# (Micikevicius et al., 2022 quantize GEMM operands only; so does every
+# production fp8 recipe).  Override hooks mirror the 16-bit lists':
+# wrap a user function with :func:`apex_tpu.amp.ops.fp8_function` (or
+# ``register_fp8_function``) to opt it into operand quantization, and
+# ``apex_tpu.amp.disable_casts()`` opts a region out — the exact knobs
+# HALF_OPS/FP32_OPS expose.
+
+FP8_OPS = [
+    # the contraction family — the only ops whose operands quantize
+    "matmul", "dot", "einsum", "dot_general", "tensordot", "linear",
+    "conv", "conv_general_dilated", "conv_transpose",
+]
+
+FP8_DENY_OPS = [
+    # never quantized below the 16-bit tables' decision: pointwise
+    # transcendentals + reductions (FP32_OPS) and the remaining half
+    # ops whose fp8 error is unbounded relative to their magnitude
+    "prelu",
+] + FP32_OPS
+
 BANNED_MESSAGE = (
     "amp does not work out-of-the-box with binary_cross_entropy on "
     "probabilities: the op requires inputs in [0,1] that a 16-bit sigmoid "

@@ -71,12 +71,122 @@ def cast_context(props: Properties):
 @contextlib.contextmanager
 def disable_casts():
     """Suspend op casting (reference ``handle.py:159-163``) — e.g. to run a
-    numerically sensitive user region in fp32 inside an O1 step."""
+    numerically sensitive user region in fp32 inside an O1 step.  Under an
+    fp8 policy this is also the opt-OUT hook for operand quantization
+    (the deny-side override the FP8 lists document)."""
     _state.disable_depth += 1
     try:
         yield
     finally:
         _state.disable_depth -= 1
+
+
+# ---------------------------------------------------------------------------
+# fp8 (O4) operand quantization
+# ---------------------------------------------------------------------------
+# Under an fp8 policy (``Properties.fp8``, the O4 opt level) the
+# contraction family quantizes its two operands onto the e4m3 grid with
+# the DELAYED scales carried in ``AmpState.fp8_state`` and rounds the
+# output's cotangent onto the e5m2 grid (``quant.fp8.bwd_qdq``).  The
+# scales enter — and the per-callsite amaxes leave — through a
+# trace-local context (:func:`fp8_trace`) opened by ``make_train_step``
+# around the loss: everything in it is a traced value of the SAME
+# trace, so the state stays purely functional (the collected amaxes
+# return through the loss aux and roll the history at end of step).
+#
+# Every e4m3/e5m2 value is exactly representable in bf16 (both formats'
+# exponent and mantissa ranges are strict subsets), so running the op
+# itself on the quantize-dequantized bf16 values accumulates EXACTLY
+# what an fp8-operand dot with ``preferred_element_type=f32`` would —
+# the native-operand spelling lives in :func:`apex_tpu.quant.fp8.
+# scaled_matmul` for callers that manage per-tensor states themselves.
+
+
+class _Fp8TraceState(threading.local):
+    def __init__(self):
+        self.scales = None    # {"input","weight","grad"} -> traced f32
+        self.amaxes = None    # {"input","weight"} -> [traced amaxes]
+
+
+_fp8_state = _Fp8TraceState()
+
+
+@contextlib.contextmanager
+def fp8_trace(fp8_train_state, grad_scale=None):
+    """Activate fp8 operand quantization for the traced extent: the
+    carried :class:`~apex_tpu.quant.fp8.Fp8TrainState` supplies the
+    delayed scales; per-callsite forward amaxes collect on the yielded
+    object (``.amaxes``) for the end-of-step history roll.
+
+    ``grad_scale`` overrides the e5m2 cotangent scale — the train step
+    passes ``grad.scale / loss_scale`` because the cotangents the
+    rounding point sees are LOSS-SCALED while the grad amax history is
+    recorded in unscaled units (unit-stable across loss-scale moves,
+    and what keeps the precision lint's scale-placement dataflow able
+    to prove the program's outputs unscaled)."""
+    prev = (_fp8_state.scales, _fp8_state.amaxes)
+    _fp8_state.scales = {"input": fp8_train_state.input.scale,
+                         "weight": fp8_train_state.weight.scale,
+                         "grad": (grad_scale if grad_scale is not None
+                                  else fp8_train_state.grad.scale)}
+    _fp8_state.amaxes = {"input": [], "weight": []}
+    try:
+        yield _fp8_state
+    finally:
+        _fp8_state.scales, _fp8_state.amaxes = prev
+
+
+def _active_fp8():
+    """The live fp8 trace context, or None — requires an fp8 policy in
+    effect AND an open :func:`fp8_trace` (a bare ``Amp.run`` under O4
+    has no scales to quantize with and degrades to the O2-style half
+    cast, documented in the policy docstring)."""
+    p = active_policy()
+    if p is None or not getattr(p, "fp8", False):
+        return None
+    if _fp8_state.scales is None:
+        return None
+    return _fp8_state
+
+
+def collected_fp8_amaxes(trace) -> "tuple":
+    """Reduce the per-callsite amaxes to one (input, weight) pair of
+    traced f32 scalars (zeros when nothing quantized)."""
+    import jax.numpy as _jnp
+    out = []
+    for kind in ("input", "weight"):
+        vals = trace.amaxes.get(kind, [])
+        out.append(_jnp.max(_jnp.stack(vals)) if vals
+                   else _jnp.asarray(0.0, _jnp.float32))
+    return tuple(out)
+
+
+def _fp8_call(fn, args, kwargs, p):
+    """The fp8 operand-quantization path: qdq the first two floating
+    array operands (input class, weight class) onto e4m3 at the delayed
+    scales, round the output's cotangent onto e5m2, record amaxes.
+    Returns None when the call shape doesn't look like a 2-operand
+    contraction (caller falls back to the half cast)."""
+    tr = _active_fp8()
+    if tr is None:
+        return None
+    flat = list(args)
+    arr_idx = [i for i, a in enumerate(flat) if _is_float_array(a)]
+    if len(arr_idx) < 2:
+        return None
+    from apex_tpu.quant import fp8 as fp8_lib
+    i, j = arr_idx[0], arr_idx[1]
+    x = jnp.asarray(flat[i]).astype(p.half_dtype)
+    w = jnp.asarray(flat[j]).astype(p.half_dtype)
+    tr.amaxes["input"].append(fp8_lib.tensor_amax(x))
+    tr.amaxes["weight"].append(fp8_lib.tensor_amax(w))
+    # straight-through qdq: rounding the cotangent is bwd_qdq's job
+    # (e5m2), not a side effect of differentiating the forward casts
+    flat[i] = fp8_lib.qdq_ste(x, tr.scales["input"], p.fp8_dtype_fwd)
+    flat[j] = fp8_lib.qdq_ste(w, tr.scales["weight"], p.fp8_dtype_fwd)
+    rest, rkw = _cast_tree((flat[j + 1:], kwargs), p.half_dtype)
+    out = fn(*flat[:j + 1], *rest, **rkw)
+    return fp8_lib.bwd_qdq(out, tr.scales["grad"])
 
 
 # ---------------------------------------------------------------------------
@@ -114,17 +224,39 @@ def _widest_float(tree: Any):
 # wrapper factories (reference wrap.py)
 # ---------------------------------------------------------------------------
 
-def half_function(fn: Callable) -> Callable:
+def half_function(fn: Callable, fp8_eligible: bool = True) -> Callable:
     """Run ``fn`` with floating inputs cast to the policy half dtype
-    (reference ``wrap.cached_cast`` → fp16, ``wrap.py:31-39``)."""
+    (reference ``wrap.cached_cast`` → fp16, ``wrap.py:31-39``).  Under
+    an fp8 policy with a live :func:`fp8_trace`, the two contraction
+    operands additionally quantize onto the e4m3 grid at the delayed
+    scales (and the cotangent onto e5m2) — the FP8_OPS behavior; calls
+    that don't look like a 2-operand contraction keep the half cast.
+    ``fp8_eligible=False`` pins a half op to the plain 16-bit cast
+    under O4 too — how the namespace enforces FP8_DENY_OPS membership
+    for ops that are HALF ops but not contractions (``prelu``)."""
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
         p = active_policy()
         if p is None:
             return fn(*args, **kwargs)
+        if fp8_eligible and getattr(p, "fp8", False):
+            out = _fp8_call(fn, args, kwargs, p)
+            if out is not None:
+                return out
         args, kwargs = _cast_tree((args, kwargs), p.half_dtype)
         return fn(*args, **kwargs)
     wrapper.__amp_wrapped__ = "half"
+    return wrapper
+
+
+def fp8_function(fn: Callable) -> Callable:
+    """Opt a user contraction into fp8 operand quantization — the
+    override hook the FP8 lists document, mirroring
+    :func:`half_function` exactly (it IS the half wrapper: under an fp8
+    policy the operands quantize, under a 16-bit policy they half-cast,
+    and :func:`disable_casts` suspends both)."""
+    wrapper = half_function(fn)
+    wrapper.__amp_wrapped__ = "fp8"
     return wrapper
 
 
@@ -196,6 +328,12 @@ def register_float_function(module: Any, name: str) -> None:
 
 def register_promote_function(module: Any, name: str) -> None:
     _register(module, name, promote_function)
+
+
+def register_fp8_function(module: Any, name: str) -> None:
+    """The fp8 analog of :func:`register_half_function` (FP8_OPS's
+    module-attribute override hook)."""
+    _register(module, name, fp8_function)
 
 
 def deactivate_registrations() -> None:
@@ -299,7 +437,10 @@ def _prelu(x, alpha):
     return jnp.where(x >= 0, x, alpha * x)
 
 
-prelu = half_function(_prelu)  # torch_overrides.py:7-26 FP16 list
+# torch_overrides.py:7-26 FP16 list — but FP8_DENY_OPS: prelu is a
+# pointwise select, not a contraction, so under O4 it keeps the plain
+# 16-bit cast (quantizing alpha would pollute the weight amax history)
+prelu = half_function(_prelu, fp8_eligible=False)
 
 # FP32_OPS — numerically sensitive work cast to fp32.
 

@@ -80,6 +80,18 @@ class Properties:
       half_dtype: the 16-bit compute dtype (bfloat16 on TPU by default).
       cast_model_outputs: if set, model outputs are cast to this dtype instead
         of fp32 (reference ``frontend.py:194`` kwarg).
+      fp8: O4's switch — matmul-family ops quantize their operands to
+        fp8 with delayed per-tensor scales (:mod:`apex_tpu.quant.fp8`)
+        and accumulate f32; the delayed-scaling state rides in
+        ``AmpState`` next to the loss scaler.  Below-16-bit is the same
+        contract one level down (Micikevicius et al., 2022), so the
+        fields live here in the same table as the 16-bit knobs.
+      fp8_dtype_fwd / fp8_dtype_bwd: the forward (e4m3) and backward
+        (e5m2) storage formats.
+      fp8_amax_history_len: rolling amax-window length of the delayed
+        scaling (the ``DelayedScalingState`` history).
+      fp8_margin: power-of-two headroom subtracted from the derived
+        scale (scale = fp8_max / (2**margin * amax_max)).
     """
 
     enabled: bool = True
@@ -91,14 +103,31 @@ class Properties:
     loss_scale: Union[float, str] = DYNAMIC
     half_dtype: Any = jnp.bfloat16
     cast_model_outputs: Optional[Any] = None
+    fp8: bool = False
+    fp8_dtype_fwd: Optional[Any] = None
+    fp8_dtype_bwd: Optional[Any] = None
+    fp8_amax_history_len: int = 16
+    fp8_margin: int = 0
 
     def __post_init__(self):
         object.__setattr__(
             self, "keep_batchnorm_fp32",
             _parse_tristate(self.keep_batchnorm_fp32, "keep_batchnorm_fp32"))
         object.__setattr__(self, "loss_scale", _parse_loss_scale(self.loss_scale))
+        if self.fp8:
+            # resolve the fp8 formats lazily so a no-fp8 policy never
+            # touches the dtypes (older jax builds may lack them)
+            if self.fp8_dtype_fwd is None:
+                object.__setattr__(self, "fp8_dtype_fwd", jnp.float8_e4m3fn)
+            if self.fp8_dtype_bwd is None:
+                object.__setattr__(self, "fp8_dtype_bwd", jnp.float8_e5m2)
+            if self.fp8_amax_history_len < 1:
+                raise ValueError(
+                    f"fp8_amax_history_len must be >= 1; got "
+                    f"{self.fp8_amax_history_len}")
         # Consistency checks mirroring frontend.py:54-82.
-        if self.cast_ops and self.cast_model_dtype is not None:
+        if self.cast_ops and self.cast_model_dtype is not None \
+                and not self.fp8:
             warnings.warn(
                 "O1-style op casting (cast_ops=True) together with a cast model "
                 "dtype is unusual; O1 expects the model left in fp32 "
@@ -164,7 +193,22 @@ def O3(half_dtype=jnp.bfloat16) -> Properties:
         half_dtype=half_dtype)
 
 
-opt_levels = {"O0": O0, "O1": O1, "O2": O2, "O3": O3}
+def O4(half_dtype=jnp.bfloat16) -> Properties:
+    """FP8 training: the O2 safety rig (fp32 masters + norm layers,
+    dynamic loss scale, 16-bit network dtype) with matmul-family ops
+    quantized to fp8 under delayed per-tensor scales — e4m3 forward,
+    e5m2 backward, f32 accumulation.  This level EXTENDS the paper's
+    table: below-16-bit needs every piece of the O2 contract plus an
+    amax-history state next to the loss scaler
+    (:class:`apex_tpu.quant.fp8.Fp8TrainState`, carried in
+    ``AmpState.fp8_state``)."""
+    return Properties(
+        opt_level="O4", cast_model_dtype=half_dtype, cast_ops=True,
+        keep_batchnorm_fp32=True, master_weights=True, loss_scale=DYNAMIC,
+        half_dtype=half_dtype, fp8=True)
+
+
+opt_levels = {"O0": O0, "O1": O1, "O2": O2, "O3": O3, "O4": O4}
 
 
 def resolve(opt_level: str = "O1",
@@ -176,7 +220,9 @@ def resolve(opt_level: str = "O1",
     if opt_level not in opt_levels:
         raise ValueError(
             f"Unexpected optimization level {opt_level!r}; options are "
-            "'O0', 'O1', 'O2', 'O3' (the letter O, not zero).")
+            "'O0', 'O1', 'O2', 'O3', 'O4' (the letter O, not zero; "
+            "O4 = fp8 training with delayed scaling, see "
+            "apex_tpu.quant).")
     props = opt_levels[opt_level](half_dtype=half_dtype)
     overrides = {k: v for k, v in overrides.items() if v is not None}
     # The reference accepts cast_model_type=False as an explicit "do not cast

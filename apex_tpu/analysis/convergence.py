@@ -1,0 +1,71 @@
+"""CONVERGENCE_r*.json — schema for the committed convergence artifacts.
+
+``tools/convergence_run.py`` writes one per round: the loss-curve /
+recovery / decode-fidelity evidence the ROADMAP's convergence story
+rests on.  Like the PRECLINT/MEMLINT/INCIDENT artifacts, these are gate
+memory — ``tools/gate_hygiene.py`` validates every committed
+``CONVERGENCE_r*.json`` against this schema so the convergence story
+can't rot into numbers nobody machine-checks.
+
+This module is deliberately **stdlib-only** (no jax import):
+``gate_hygiene`` loads it directly by file path.
+
+Two document shapes are valid (both exist in-tree):
+
+- the legacy single-record shape (round 2: one imagenet record with a
+  top-level ``ok`` bool and ``platform``);
+- the multi-record shape (round 3+): ``platform``, ``all_ok`` (bool),
+  and one dict per lane (``gpt_pysrc``, ``o4_mnist``,
+  ``int8_kv_decode``, ...), each carrying its own ``ok`` bool — except
+  ``anchors``, the external-baseline record that has no pass/fail of
+  its own.  ``all_ok`` must equal the conjunction of the lanes' ``ok``
+  flags (the verdict must be derivable from the document alone).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+#: record keys that are metadata, not pass/fail lanes
+_NON_LANE_KEYS = ("anchors",)
+
+
+def validate_convergence(doc) -> List[str]:
+    """Problems with one parsed CONVERGENCE document (empty = valid)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not a JSON object"]
+    if not isinstance(doc.get("platform"), str):
+        problems.append("missing/invalid 'platform' (str)")
+    if isinstance(doc.get("ok"), bool) and "all_ok" not in doc:
+        # legacy single-record shape: the document IS the lane
+        return problems
+    if not isinstance(doc.get("all_ok"), bool):
+        return problems + [
+            "missing/invalid 'all_ok' (bool; or legacy top-level 'ok')"]
+    lanes = {k: v for k, v in doc.items()
+             if isinstance(v, dict) and k not in _NON_LANE_KEYS}
+    if not lanes:
+        return problems + ["no lane records (dict values)"]
+    oks = []
+    for name, lane in lanes.items():
+        if not isinstance(lane.get("ok"), bool):
+            problems.append(f"lane {name!r} missing 'ok' (bool)")
+        else:
+            oks.append(lane["ok"])
+    if oks and not problems and doc["all_ok"] != all(oks):
+        problems.append(
+            f"all_ok={doc['all_ok']} contradicts the lanes' ok flags "
+            f"(conjunction is {all(oks)})")
+    return problems
+
+
+def validate_convergence_file(path: str) -> List[str]:
+    """Problems with one CONVERGENCE_r*.json file (empty = valid)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable convergence JSON: {e}"]
+    return validate_convergence(doc)

@@ -62,10 +62,36 @@ Finding ids (the ``op`` field of each :class:`Finding`):
     The lowered argument list could not be matched to the kept example
     args (numbering ambiguous), so loss-scale placement was NOT checked
     — the degradation is surfaced, never silent.
+``fp8-same-step-scale`` (error)
+    A quantize (``convert`` to an f8 type) whose scale chain is derived
+    from an amax (max-reduce) computed **in the same program** from
+    live data.  The fp8 contract is *delayed* scaling (Micikevicius et
+    al., 2022 §4): the scale must enter as a program INPUT (the carried
+    ``DelayedScalingState``), both because a same-step amax serializes
+    the quantize behind a full reduction of the tensor it quantizes,
+    and because it silently changes the numbers the history-based
+    recipe was validated on.  (int8 KV quantization is exempt by
+    construction — its converts target ``i8``, and its per-write
+    dynamic scale is the documented format.)
+``fp8-amax-unrecorded`` (error)
+    Under an fp8 policy, a program that quantizes to f8 but whose
+    amax-history update never reaches an output: either no max-reduce
+    exists at all, or none of its results flow into the returned state
+    — the delayed scale would free-run on stale statistics forever
+    (the state-threading bug class the O4 lanes exist to catch).
+``fp8-double-quantize`` (error)
+    A ``convert`` to f8 whose operand derives (through pure
+    value-chain ops — converts, rescales, reshapes) from a value that
+    was ALREADY f8: a dequantize-requantize round trip rounds twice
+    and composes two scales where the format budgets mantissa for one.
+    Contractions break the chain — a dot of f8 operands produces new
+    data whose own quantization is legitimate (per-op gradient
+    rounding across layers is the documented backward recipe, not a
+    double quantize).
 ``precision-summary`` (info)
     Per-lane counters (scale applications, unscales, dots/reduces/
-    converts/collectives checked) — the PRECLINT artifact's evidence
-    that the pass actually looked.
+    converts/collectives/f8-quantizes checked) — the PRECLINT
+    artifact's evidence that the pass actually looked.
 
 Scale tracking is a five-class forward dataflow over
 :mod:`apex_tpu.analysis.dflow`'s SSA view — ``N`` plain value, ``C``
@@ -109,6 +135,75 @@ _PREDICATES = frozenset((
 ))
 _LOSSY_REDUCERS = ("stablehlo.add", "stablehlo.multiply")
 _GRAD_COLLECTIVES = ("all_reduce", "reduce_scatter")
+
+#: ops a scale/value chain flows through for the fp8 provenance walks
+#: (structural moves + the rescale arithmetic of quantize/dequantize);
+#: contractions and transcendentals deliberately BREAK the chain
+_FP8_CHAIN = frozenset((
+    "multiply", "divide", "broadcast_in_dim", "broadcast", "reshape",
+    "convert", "clamp", "select", "transpose", "negate", "maximum",
+    "minimum", "concatenate", "slice", "dynamic_slice", "copy",
+    # jnp.clip lowers as a private @clip call: the quantize's clamp is
+    # a call on some jax versions, and a provenance walk that a call
+    # boundary could launder would miss every real bug
+    "call",
+))
+
+
+def _is_f8(elem: Optional[str]) -> bool:
+    """True for the fp8 element spellings (``f8E4M3FN``, ``f8E5M2``,
+    ``f8E4M3``, ...)."""
+    return bool(elem) and elem.startswith("f8")
+
+
+def _max_reduce_results(fn, def_map, abs_only: bool = False) -> set:
+    """Result tokens of every max-reduce in ``fn`` — the amax
+    computations of both quantization recipes.  ``abs_only`` keeps
+    only reduces whose input is an ``abs`` result (``max(|x|)``, the
+    amax spelling): the reachability check must not be satisfied by
+    softmax's numerical-stability max-reduce — every transformer has
+    one flowing into the loss, which would mask a dropped
+    history-roll entirely."""
+    out = set()
+    for op in fn.ops:
+        if op.name != "reduce" or op.result is None:
+            continue
+        is_max = "stablehlo.maximum" in op.line
+        if not is_max:
+            for ret in op.region_returns:
+                d = def_map.get(base_token(ret[0])) if ret else None
+                if d is not None and d.name == "maximum":
+                    is_max = True
+        if not is_max:
+            continue
+        if abs_only:
+            src = def_map.get(fn.resolve(op.operands[0])) \
+                if op.operands else None
+            if src is None or src.name != "abs":
+                continue
+        out.add(op.result)
+    return out
+
+
+def _propagate(fn, roots: set, through=None) -> set:
+    """Forward closure of ``roots`` over ``fn``'s ops: a result joins
+    when any operand (while-aliases resolved) is in the set.
+    ``through=None`` propagates through every op; a frozenset restricts
+    to those op names."""
+    derived = set(roots)
+    for _ in range(4):                      # while-carried chains
+        changed = False
+        for op in fn.ops:
+            if op.result is None or op.result in derived:
+                continue
+            if through is not None and op.name not in through:
+                continue
+            if any(fn.resolve(t) in derived for t in op.operands):
+                derived.add(op.result)
+                changed = True
+        if not changed:
+            break
+    return derived
 
 
 def _half_name(policy) -> str:
@@ -324,11 +419,15 @@ def precision_report(ctx: PassContext, policy: Any = None,
     half = _half_name(policy) if policy is not None else "bf16"
     opt_level = getattr(policy, "opt_level", None)
     enabled = getattr(policy, "enabled", True)
-    #: O3 opted out of the safety contract: dtype findings demote to info
-    strict = opt_level in (None, "O0", "O1", "O2")
+    #: O3 opted out of the safety contract: dtype findings demote to
+    #: info.  O4 is the OPPOSITE of an opt-out — fp8 only works at all
+    #: because the full contract (masters, dynamic scale, delayed
+    #: scaling) is enforced — so it lints strict like O0–O2.
+    strict = opt_level in (None, "O0", "O1", "O2", "O4")
     findings: List[Finding] = []
     stats = {"dots": 0, "reduces": 0, "converts": 0, "collectives": 0,
-             "scale_args": 0, "scale_applied": 0, "unscaled": 0}
+             "scale_args": 0, "scale_applied": 0, "unscaled": 0,
+             "fp8_quantizes": 0}
 
     funcs = ctx.memo("dflow",
                      lambda: parse_module(ctx.stablehlo_text))
@@ -455,6 +554,69 @@ def precision_report(ctx: PassContext, policy: Any = None,
                         op="comm-dtype", dtype=elem, lineno=op.lineno,
                         example=op.line.strip()[:200]))
 
+    # -- the fp8 contract (delayed scaling + no-double-quantize) ---------
+    fp8_policy = bool(getattr(policy, "fp8", False)) and enabled
+    any_f8 = False
+    for fn in funcs.values():
+        f8_converts = [op for op in fn.ops
+                       if op.name == "convert" and _is_f8(op.result_elem)
+                       and op.result is not None]
+        if not f8_converts:
+            continue
+        any_f8 = True
+        stats["fp8_quantizes"] += len(f8_converts)
+        amax_roots = _max_reduce_results(fn, def_map)
+        # scale chains seeded by in-program amaxes (the same-step bug)
+        amax_derived = _propagate(fn, amax_roots, through=_FP8_CHAIN)
+        # value chains seeded by already-f8 values (double quantize)
+        f8_vals = {op.result for op in f8_converts}
+        f8_derived = _propagate(fn, f8_vals, through=_FP8_CHAIN)
+        for op in f8_converts:
+            src = fn.resolve(op.operands[0]) if op.operands else None
+            if src in amax_derived:
+                findings.append(Finding(
+                    "precision", "error" if strict else "info",
+                    f"f8 quantize at line {op.lineno} consumes a scale "
+                    f"derived from a SAME-STEP amax (max-reduce in this "
+                    f"program): the fp8 contract is DELAYED scaling — "
+                    f"the scale must be a carried input "
+                    f"(DelayedScalingState), derived from past steps' "
+                    f"amax history",
+                    op="fp8-same-step-scale", dtype=op.result_elem,
+                    lineno=op.lineno, example=op.line.strip()[:200]))
+            if src in f8_derived and src not in f8_vals:
+                findings.append(Finding(
+                    "precision", "error" if strict else "info",
+                    f"f8 quantize at line {op.lineno} re-quantizes a "
+                    f"value that was already f8 (dequantize→requantize "
+                    f"round trip: two roundings, two scales composed "
+                    f"where the format budgets mantissa for one)",
+                    op="fp8-double-quantize", dtype=op.result_elem,
+                    lineno=op.lineno, example=op.line.strip()[:200]))
+    if fp8_policy and any_f8:
+        # amax-history update reachability: under the fp8 policy, some
+        # AMAX (max over |x| — abs_only, so softmax's stability max
+        # can't satisfy the check) must flow into a program output
+        # (the recorded history / re-derived scale of the carried
+        # state)
+        amax_roots = _max_reduce_results(main, def_map, abs_only=True)
+        if amax_roots:
+            touched = _propagate(main, amax_roots, through=None)
+            returned_tokens = {main.resolve(t) for ret in main.returns
+                               for t in ret.operands}
+            recorded = bool(returned_tokens & touched)
+        else:
+            recorded = False
+        if not recorded:
+            findings.append(Finding(
+                "precision", "error" if strict else "info",
+                "this fp8 program never records an amax into the "
+                "carried state: no max-reduce result reaches a program "
+                "output, so the delayed scale would free-run on stale "
+                "statistics (the amax-history roll must flow into the "
+                "returned Fp8TrainState)",
+                op="fp8-amax-unrecorded"))
+
     # -- master-weight / moment dtypes (argument table) ------------------
     if policy is not None and enabled and _use_master_weights(policy):
         for a in ctx.args:
@@ -526,7 +688,8 @@ def precision_report(ctx: PassContext, policy: Any = None,
         "precision", "info",
         f"checked {stats['dots']} matmul/conv, {stats['reduces']} lossy "
         f"reduce(s), {stats['converts']} f32→16 convert(s), "
-        f"{stats['collectives']} gradient collective(s); loss scale: "
+        f"{stats['collectives']} gradient collective(s), "
+        f"{stats['fp8_quantizes']} f8 quantize(s); loss scale: "
         f"{stats['scale_args']} input(s), {stats['scale_applied']} "
         f"application(s), {stats['unscaled']} unscale(s)",
         op="precision-summary"))

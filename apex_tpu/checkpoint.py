@@ -50,6 +50,10 @@ def payload_template(state: AmpState,
             {"loss_scale": s.loss_scale, "unskipped": s.unskipped}
             for s in state.scaler_states],
         "step": state.step,
+        # O4's delayed-scaling state (quant.fp8.Fp8TrainState) — None
+        # below O4, which contributes no leaves, so pre-fp8 checkpoints
+        # and templates keep matching structurally.
+        "fp8_state": state.fp8_state,
         # Always present (possibly empty) so save/restore tree structures
         # match whenever both sides pass the same extras template.
         "extras": extras if extras else {},
@@ -111,6 +115,15 @@ def load_state_dict(template: AmpState, d: Dict[str, Any]
     structural mismatch raises naming the first diverging leaf path."""
     target = payload_template(template)
     del target["extras"]    # extras follow their own (optional) contract
+    # O2→O4 warm start: a pre-fp8 checkpoint (no "fp8_state" key)
+    # restoring into an fp8 template keeps the template's FRESH
+    # delayed-scaling state — the amax history is a running statistic
+    # of the new regime, not trained state, so "start fresh" is the
+    # correct semantics (masters/moments/scalers still restore).
+    warm_start_fp8 = template.fp8_state is not None \
+        and "fp8_state" not in d
+    if warm_start_fp8:
+        del target["fp8_state"]
     saved = {k: d.get(k) for k in target}
     check_same_structure(_leaf_keys(saved), _leaf_keys(target))
 
@@ -125,11 +138,16 @@ def load_state_dict(template: AmpState, d: Dict[str, Any]
             unskipped=jax.numpy.asarray(sd["unskipped"],
                                         dtype=ref.unskipped.dtype))
         for sd, ref in zip(d["scaler_states"], template.scaler_states))
+    fp8_state = None
+    if template.fp8_state is not None:
+        fp8_state = template.fp8_state if warm_start_fp8 \
+            else like(d["fp8_state"], template.fp8_state)
     state = AmpState(
         master_params=like(d["master_params"], template.master_params),
         opt_state=like(d["opt_state"], template.opt_state),
         scaler_states=scalers,
         step=jax.numpy.asarray(d["step"], dtype=template.step.dtype),
+        fp8_state=fp8_state,
     )
     return state, d.get("extras", {})
 

@@ -71,7 +71,8 @@ def _ln(x, p, eps):
                                    x.shape[-1], eps)
 
 
-def _attn_cached(q, k_cache, v_cache, valid_mask, scale):
+def _attn_cached(q, k_cache, v_cache, valid_mask, scale,
+                 k_scale=None, v_scale=None):
     """fp32-softmax attention of ``q (B, Lq, H, D)`` against the full
     cache ``(B, M, H, D)`` with a validity mask (True = attend) of
     shape ``(Lq, M)`` (shared across the batch — this module's decode/
@@ -85,19 +86,36 @@ def _attn_cached(q, k_cache, v_cache, valid_mask, scale):
     the (B, M, H, D) f32 cache copies are no longer in the program for
     XLA to materialize — DECODE_DECOMPOSE_r01 found the per-step cache
     converts/slice-copies to be the largest static candidates for the
-    b8 0.43-of-ceiling gap (kv_read is 69% of modeled step traffic)."""
+    b8 0.43-of-ceiling gap (kv_read is 69% of modeled step traffic).
+
+    ``k_scale``/``v_scale`` ``(B, M)`` select the **int8 KV** read
+    path (``kv_dtype="int8"``): the caches hold int8 values with one
+    f32 scale per cached position, and dequantization FUSES into the
+    attention math — the per-position K scale multiplies the (B, H,
+    Lq, M) scores and the V scale folds into the probability weights,
+    exact in real arithmetic because each scale is constant over the
+    contracted (H, D) axes (:func:`apex_tpu.quant.int8.
+    kv_dequant_scales`).  The int8→f32 operand embed is exact like the
+    bf16 one, so no dequantized (B, M, H, D) cache ever materializes —
+    the read stays at 1 byte/element, which is the entire point (the
+    ~2x decode-ceiling lift of the kv8 bench config)."""
     mask = valid_mask[None, None] if valid_mask.ndim == 2 \
         else valid_mask[:, None]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
                    preferred_element_type=jnp.float32) * scale
+    if k_scale is not None:
+        s = s * k_scale[:, None, None, :]
     s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if v_scale is not None:
+        p = p * v_scale[:, None, None, :]
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v_cache,
                      preferred_element_type=jnp.float32)
     return out.astype(q.dtype)
 
 
-def _block(x, p, cfg, kc, vc, layer_i, cos, sin, valid_mask, write_at):
+def _block(x, p, cfg, kc, vc, layer_i, cos, sin, valid_mask, write_at,
+           ks=None, vs=None):
     """One transformer block over ``x (B, Lq, E)`` with cache update at
     ``(layer_i, :, write_at)``; mirrors GPTBlock/CausalSelfAttention
     exactly.
@@ -110,7 +128,12 @@ def _block(x, p, cfg, kc, vc, layer_i, cos, sin, valid_mask, write_at):
     re-copied both full caches every decode step (profiled as two
     ~264 ms ``copy`` ops per 256-token generation — ~30% of step
     time), while carry buffers alias in place across ``while``-loop
-    iterations and only the written slot touches memory."""
+    iterations and only the written slot touches memory.
+
+    ``ks``/``vs`` ``(L, B, M)`` f32 select the int8 KV format: each
+    written token quantizes with its own per-position absmax scale
+    (:func:`apex_tpu.quant.int8.quantize_kv`) and the read fuses the
+    dequant into the attention math (:func:`_attn_cached`)."""
     c = cfg
     head_dim = c.hidden_size // c.num_heads
     scale = 1.0 / float(head_dim) ** 0.5
@@ -125,10 +148,23 @@ def _block(x, p, cfg, kc, vc, layer_i, cos, sin, valid_mask, write_at):
     v = v.reshape(b, lq, c.num_heads, head_dim)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)  # rotated keys cached (standard layout)
-    kc = jax.lax.dynamic_update_slice(
-        kc, k.astype(kc.dtype)[None], (layer_i, 0, write_at, 0, 0))
-    vc = jax.lax.dynamic_update_slice(
-        vc, v.astype(vc.dtype)[None], (layer_i, 0, write_at, 0, 0))
+    if ks is not None:
+        from apex_tpu.quant import int8 as int8_lib
+        qk, sk = int8_lib.quantize_kv(k)      # (B,Lq,H,D) i8, (B,Lq) f32
+        qv, sv = int8_lib.quantize_kv(v)
+        kc = jax.lax.dynamic_update_slice(
+            kc, qk[None], (layer_i, 0, write_at, 0, 0))
+        vc = jax.lax.dynamic_update_slice(
+            vc, qv[None], (layer_i, 0, write_at, 0, 0))
+        ks = jax.lax.dynamic_update_slice(
+            ks, sk[None], (layer_i, 0, write_at))
+        vs = jax.lax.dynamic_update_slice(
+            vs, sv[None], (layer_i, 0, write_at))
+    else:
+        kc = jax.lax.dynamic_update_slice(
+            kc, k.astype(kc.dtype)[None], (layer_i, 0, write_at, 0, 0))
+        vc = jax.lax.dynamic_update_slice(
+            vc, v.astype(vc.dtype)[None], (layer_i, 0, write_at, 0, 0))
     if lq > 1 and _concrete_zero(write_at):
         # full prefill: rows 0..lq-1 attending to cache slots <= their
         # own position IS causal self-attention over the
@@ -151,7 +187,14 @@ def _block(x, p, cfg, kc, vc, layer_i, cos, sin, valid_mask, write_at):
                                             keepdims=False)
         vc_l = jax.lax.dynamic_index_in_dim(vc, layer_i, 0,
                                             keepdims=False)
-        o = _attn_cached(q, kc_l, vc_l, valid_mask, scale)
+        ks_l = vs_l = None
+        if ks is not None:
+            ks_l = jax.lax.dynamic_index_in_dim(ks, layer_i, 0,
+                                                keepdims=False)
+            vs_l = jax.lax.dynamic_index_in_dim(vs, layer_i, 0,
+                                                keepdims=False)
+        o = _attn_cached(q, kc_l, vc_l, valid_mask, scale,
+                         k_scale=ks_l, v_scale=vs_l)
     o = o.reshape(b, lq, c.hidden_size)
     x = x + (o @ p["attention"]["out"]["kernel"]
              + p["attention"]["out"]["bias"].astype(o.dtype))
@@ -160,16 +203,19 @@ def _block(x, p, cfg, kc, vc, layer_i, cos, sin, valid_mask, write_at):
     h = jax.nn.gelu(h)  # tanh approximation, as flax nn.gelu in training
     return (x + (h @ p["ffn_out"]["kernel"]
                  + p["ffn_out"]["bias"].astype(h.dtype)),
-            kc, vc)
+            kc, vc, ks, vs)
 
 
-def _forward_cached(params, stacked, cfg, ids, kc, vc, start: int):
+def _forward_cached(params, stacked, cfg, ids, kc, vc, start: int,
+                    ks=None, vs=None):
     """Embed ``ids (B, Lq)`` at global positions ``start..start+Lq-1``,
     run all layers with cache writes at ``start``, return final-token
     logits and updated caches.  ``start`` may be traced (decode and
     chunked prefill — a multi-token chunk appended mid-sequence
     attends to the cached history through the einsum path) or a
-    concrete 0 (full prefill through the flash kernel)."""
+    concrete 0 (full prefill through the flash kernel).  ``ks``/``vs``
+    carry the int8 KV format's per-position scales (None = dense
+    16/32-bit cache)."""
     c = cfg
     b, lq = ids.shape
     m = kc.shape[2]
@@ -185,22 +231,23 @@ def _forward_cached(params, stacked, cfg, ids, kc, vc, start: int):
     # caches ride the CARRY as whole (L, B, M, H, D) buffers — scan ys
     # would restack (copy) both full caches every call (see _block)
     def layer(carry, inputs):
-        x, kc, vc = carry
+        x, kc, vc, ks, vs = carry
         p_l, layer_i = inputs
-        x, kc, vc = _block(x, p_l, c, kc, vc, layer_i, cos, sin, valid,
-                           write_at=start)
-        return (x, kc, vc), None
+        x, kc, vc, ks, vs = _block(x, p_l, c, kc, vc, layer_i, cos, sin,
+                                   valid, write_at=start, ks=ks, vs=vs)
+        return (x, kc, vc, ks, vs), None
 
-    (x, kc, vc), _ = jax.lax.scan(
-        layer, (x, kc, vc), (stacked, jnp.arange(c.num_layers)))
+    (x, kc, vc, ks, vs), _ = jax.lax.scan(
+        layer, (x, kc, vc, ks, vs), (stacked, jnp.arange(c.num_layers)))
     x = _ln(x[:, -1:], params["ln_f"], c.layer_norm_eps)
     logits = x[:, 0] @ params["lm_head"]["kernel"]
-    return logits, kc, vc
+    return logits, kc, vc, ks, vs
 
 
 def generate(params, cfg: GPTConfig, prompt_ids, max_new_tokens: int,
              temperature: float = 0.0,
-             rng: Optional[jax.Array] = None):
+             rng: Optional[jax.Array] = None,
+             kv_dtype: Optional[str] = None):
     """Decode ``max_new_tokens`` tokens after ``prompt_ids (B, L)``.
 
     Returns ``(B, L + max_new_tokens)`` ids.  ``temperature=0`` is
@@ -212,10 +259,20 @@ def generate(params, cfg: GPTConfig, prompt_ids, max_new_tokens: int,
     for repeated generation from a big loop-layout checkpoint, pre-pack
     once with the scan layout (``params["layers"]["block"]``) to skip
     the per-call copy.
+
+    ``kv_dtype="int8"`` stores the KV cache as int8 with one f32 scale
+    per cached position (quantized on write, dequant fused into the
+    attention read — :mod:`apex_tpu.quant.int8`): half the cache bytes
+    of the bf16 layout, a ~2x ceiling lift on the HBM-bound decode
+    step, within the documented greedy token-match tolerance of the
+    dense cache (``docs/source/quantization.rst``).
     """
     sample = float(temperature) > 0.0
     if sample and rng is None:
         raise ValueError("temperature sampling requires rng")
+    if kv_dtype not in (None, "int8"):
+        raise ValueError(f"kv_dtype must be None or 'int8'; got "
+                         f"{kv_dtype!r}")
     stacked = _stack_layer_params(params, cfg.num_layers)
     top = {k: v for k, v in params.items()
            if not k.startswith("block_") and k != "layers"}
@@ -224,23 +281,33 @@ def generate(params, cfg: GPTConfig, prompt_ids, max_new_tokens: int,
     return _generate_impl(top, stacked, prompt_ids,
                           jnp.float32(temperature), rng, cfg=cfg,
                           max_new_tokens=int(max_new_tokens),
-                          sample=sample)
+                          sample=sample, kv_dtype=kv_dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens",
-                                             "sample"))
+                                             "sample", "kv_dtype"))
 def _generate_impl(top, stacked, prompt_ids, temperature, rng, *,
-                   cfg: GPTConfig, max_new_tokens: int, sample: bool):
+                   cfg: GPTConfig, max_new_tokens: int, sample: bool,
+                   kv_dtype: Optional[str] = None):
     c = cfg
     b, lp = prompt_ids.shape
     m = lp + max_new_tokens
     head_dim = c.hidden_size // c.num_heads
     dtype = top["tok_emb"]["embedding"].dtype
-    kc = jnp.zeros((c.num_layers, b, m, c.num_heads, head_dim), dtype)
+    if kv_dtype == "int8":
+        kc = jnp.zeros((c.num_layers, b, m, c.num_heads, head_dim),
+                       jnp.int8)
+        ks = jnp.zeros((c.num_layers, b, m), jnp.float32)
+        vs = jnp.zeros_like(ks)
+    else:
+        kc = jnp.zeros((c.num_layers, b, m, c.num_heads, head_dim),
+                       dtype)
+        ks = vs = None
     vc = jnp.zeros_like(kc)
 
-    logits, kc, vc = _forward_cached(top, stacked, c, prompt_ids,
-                                     kc, vc, start=0)
+    logits, kc, vc, ks, vs = _forward_cached(top, stacked, c, prompt_ids,
+                                             kc, vc, start=0, ks=ks,
+                                             vs=vs)
 
     def pick(logits, key):
         if sample:
@@ -252,15 +319,15 @@ def _generate_impl(top, stacked, prompt_ids, temperature, rng, *,
     first = pick(logits, key0).astype(prompt_ids.dtype)
 
     def step(carry, key):
-        tok, t, kc, vc = carry
-        logits, kc, vc = _forward_cached(top, stacked, c, tok[:, None],
-                                         kc, vc, start=t)
+        tok, t, kc, vc, ks, vs = carry
+        logits, kc, vc, ks, vs = _forward_cached(
+            top, stacked, c, tok[:, None], kc, vc, start=t, ks=ks, vs=vs)
         nxt = pick(logits, key).astype(tok.dtype)
-        return (nxt, t + 1, kc, vc), nxt
+        return (nxt, t + 1, kc, vc, ks, vs), nxt
 
     keys = jax.random.split(rng, max(max_new_tokens - 1, 1))
-    (_, _, _, _), rest = jax.lax.scan(
-        step, (first, jnp.asarray(lp, jnp.int32), kc, vc),
+    (_, _, _, _, _, _), rest = jax.lax.scan(
+        step, (first, jnp.asarray(lp, jnp.int32), kc, vc, ks, vs),
         keys[: max_new_tokens - 1])
     out = jnp.concatenate(
         [prompt_ids, first[:, None], jnp.moveaxis(rest, 0, 1)], axis=1)

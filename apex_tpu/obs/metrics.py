@@ -464,6 +464,19 @@ def instrument_step(step_fn: Callable, registry: Optional[Registry] = None,
     loss_g = reg.gauge(f"{name}_loss", "last resolved loss (1-step lag)")
     over_c = reg.counter(f"{name}_overflows_total",
                          "loss-scale overflow skips (1-step lag)")
+    # O4 fp8 regime telemetry (present only when the step's metrics
+    # carry them — make_train_step under an fp8 policy): both are
+    # step OUTPUTS recorded as deferred device values at the existing
+    # lag-resolved point, so the instrumentation adds zero host syncs
+    # (the graph-lint syncs pass on the O4 lane pins the program side)
+    fp8_sat = reg.gauge(
+        f"{name}_fp8_amax_saturation",
+        "fp8 dynamic-range utilization of the worst tensor class "
+        "(amax * delayed scale / fp8_max; >1 = clipped, 1-step lag)")
+    fp8_resc = reg.counter(
+        f"{name}_fp8_rescales_total",
+        "fp8 overflow-to-rescale events: tensor classes whose delayed "
+        "scale shrank after the step's amax roll (1-step lag)")
 
     def wrapped(state, *args, **kwargs):
         t0 = time.perf_counter()
@@ -477,6 +490,10 @@ def instrument_step(step_fn: Callable, registry: Optional[Registry] = None,
                 loss_g.set(m["loss"])
             if "overflow" in m:
                 over_c.inc(m["overflow"])
+            if "fp8_amax_saturation" in m:
+                fp8_sat.set(m["fp8_amax_saturation"])
+            if "fp8_rescales" in m:
+                fp8_resc.inc(m["fp8_rescales"])
         reg.tick()
         return out
 

@@ -1,0 +1,56 @@
+"""apex_tpu.quant — fp8/int8 as a first-class precision regime.
+
+The paper's whole apparatus — the policy table, the cast lists, dynamic
+loss scaling, fp32 master weights — is a machine for running *below*
+fp32 safely, and it generalizes below 16-bit:
+
+- :mod:`apex_tpu.quant.fp8` is the FP8-training half (Micikevicius et
+  al., *FP8 Formats for Deep Learning*, 2022): e4m3/e5m2 quantization
+  with per-tensor scales, a pure-pytree :class:`~apex_tpu.quant.fp8.
+  DelayedScalingState` (amax history + scale derivation) that lives in
+  ``AmpState`` next to the loss scaler, and scaled-matmul helpers that
+  cast operands to fp8 and accumulate f32 via
+  ``preferred_element_type``.  The O4 opt level
+  (``amp.resolve("O4")``) drives it through the policy-aware op layer.
+- :mod:`apex_tpu.quant.int8` is the inference half (Dettmers et al.,
+  *LLM.int8()*, 2022): symmetric per-channel int8 weight quantization
+  plus the per-slot int8 KV-cache format the decode path reads
+  (``kv_dtype="int8"`` in :func:`apex_tpu.models.generate.generate`
+  and :class:`apex_tpu.serve.ServeConfig`) — decode is HBM-bound with
+  kv_read at 69% of the ideal step (DECODE_DECOMPOSE_r01), so halving
+  the cache bytes is a ~2x decode-ceiling lift.
+
+Both regimes are machine-checked from day one: the precision-flow lint
+(:mod:`apex_tpu.analysis.precision`) carries the fp8 contract
+(delayed-scale placement, amax-history recording, no-double-quantize)
+and ``tools/graph_lint.py`` runs O4 train lanes and the int8-KV decode
+lane.  See ``docs/source/quantization.rst``.
+"""
+
+from apex_tpu.quant.fp8 import (  # noqa: F401
+    FP8_E4M3,
+    FP8_E5M2,
+    DelayedScalingState,
+    bwd_qdq,
+    Fp8TrainState,
+    delayed_scale,
+    dequantize,
+    fp8_max,
+    init_delayed_scaling,
+    init_train_state,
+    qdq,
+    qdq_ste,
+    quantize,
+    record_amax,
+    rescale_events,
+    scaled_matmul,
+    step_saturation,
+    tree_amax,
+    update_train_state,
+)
+from apex_tpu.quant.int8 import (  # noqa: F401
+    dequantize_int8,
+    kv_dequant_scales,
+    quantize_int8,
+    quantize_kv,
+)

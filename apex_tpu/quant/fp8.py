@@ -1,0 +1,297 @@
+"""FP8 quantization with per-tensor scales and **delayed scaling**.
+
+The contract (Micikevicius et al., *FP8 Formats for Deep Learning*,
+2022, §4) is the loss scaler's contract one level down: a tensor class
+is quantized as ``q = clip(x * scale)`` cast to e4m3 (forward
+activations/weights) or e5m2 (backward cotangents — more exponent, less
+mantissa, because gradients need range, not precision), and the scale
+is **delayed** — derived from a rolling history of past steps' absolute
+maxima, never from the same step's amax (which would serialize the
+quantize behind a full reduction of the tensor it quantizes, and is the
+seeded-bug pattern the precision lint's ``fp8-same-step-scale`` rule
+fires on).  Everything here is a pure pytree transition so the state
+jits, donates, and checkpoints exactly like
+:class:`~apex_tpu.amp.scaler.LossScaleState` — the O4 opt level carries
+one :class:`Fp8TrainState` in ``AmpState`` next to the loss scaler.
+
+Matmuls run with genuinely-fp8 operands and **f32 accumulation** via
+``preferred_element_type`` (:func:`scaled_matmul`): the MXU contract
+for fp8 is the bf16 contract with one more octave of cheap — the
+accumulator must never be the storage dtype (the precision lint's
+``half-accum-matmul`` logic already owns that invariant; fp8 rides the
+same machinery).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: the two FP8 storage formats (IEEE-754-2019 binary8 variants as
+#: ml_dtypes spells them): e4m3 = forward (max 448, 3 mantissa bits),
+#: e5m2 = backward (max 57344, gradients need range over precision)
+FP8_E4M3 = jnp.float8_e4m3fn
+FP8_E5M2 = jnp.float8_e5m2
+
+_FP8_MAX = {jnp.dtype(FP8_E4M3): 448.0, jnp.dtype(FP8_E5M2): 57344.0}
+
+
+def fp8_max(dtype) -> float:
+    """Largest finite value of an fp8 storage dtype."""
+    try:
+        return _FP8_MAX[jnp.dtype(dtype)]
+    except KeyError:
+        raise ValueError(f"not an fp8 dtype: {dtype!r}") from None
+
+
+class DelayedScalingState(NamedTuple):
+    """Per-tensor(-class) delayed-scaling state — a pure pytree.
+
+    ``amax_history`` is a rolling ``(history_len,)`` f32 window of past
+    steps' absolute maxima (newest at index 0); ``scale`` is the
+    quantization scale derived from that window at the END of the
+    previous step — the *delayed* scale this step's quantize consumes.
+    Carrying the derived scale (instead of re-deriving from history at
+    use time) is what makes the delay statically visible: the quantize
+    multiplies by a program INPUT, never by an in-graph amax.
+    """
+
+    amax_history: jax.Array   # (H,) f32, newest first
+    scale: jax.Array          # () f32
+
+
+def init_delayed_scaling(history_len: int = 16,
+                         scale: float = 1.0) -> DelayedScalingState:
+    """Fresh state: empty (zero) history, unit scale.  A zero history
+    derives a unit scale too (:func:`delayed_scale`), so the first
+    steps quantize conservatively until real amaxes fill the window."""
+    if history_len < 1:
+        raise ValueError(f"history_len={history_len}")
+    return DelayedScalingState(
+        amax_history=jnp.zeros((history_len,), jnp.float32),
+        scale=jnp.asarray(scale, jnp.float32))
+
+
+def delayed_scale(state: DelayedScalingState, dtype,
+                  margin: int = 0) -> jax.Array:
+    """Derive the next step's scale from the current history:
+    ``fp8_max(dtype) / (2**margin * max(history))``, unit scale while
+    the history is still all-zero (warmup) and clamped finite."""
+    amax = jnp.max(state.amax_history)
+    target = jnp.asarray(fp8_max(dtype) / (2.0 ** margin), jnp.float32)
+    scale = jnp.where(amax > 0.0, target / jnp.maximum(amax, 1e-30), 1.0)
+    return jnp.clip(scale, 1e-30, 1e30).astype(jnp.float32)
+
+
+def record_amax(state: DelayedScalingState, amax: jax.Array, dtype,
+                margin: int = 0) -> DelayedScalingState:
+    """End-of-step transition: roll ``amax`` into the history (newest
+    first) and re-derive the scale for the NEXT step.  The scale in the
+    returned state is therefore always one step behind the newest amax
+    it was derived from — the delayed-scaling contract.
+
+    A non-finite amax records as 0 (no range information): an
+    overflowed backward under dynamic loss scaling produces inf/nan
+    gradients on exactly the steps the loss scaler SKIPS, and one nan
+    in the window would otherwise poison ``max(history)`` for the next
+    ``history_len`` steps."""
+    amax = jnp.asarray(amax, jnp.float32)
+    amax = jnp.where(jnp.isfinite(amax), amax, 0.0)
+    hist = jnp.concatenate([amax[None], state.amax_history[:-1]])
+    new = DelayedScalingState(amax_history=hist, scale=state.scale)
+    return DelayedScalingState(amax_history=hist,
+                               scale=delayed_scale(new, dtype, margin))
+
+
+def quantize(x: jax.Array, scale: jax.Array, dtype=FP8_E4M3) -> jax.Array:
+    """``clip(x * scale)`` cast to fp8.  ``scale`` is the DELAYED scale
+    (a carried state leaf) — deriving it from ``x`` itself in the same
+    program is the ``fp8-same-step-scale`` lint error."""
+    m = fp8_max(dtype)
+    return jnp.clip(x.astype(jnp.float32) * scale, -m, m).astype(dtype)
+
+
+def dequantize(q: jax.Array, scale: jax.Array,
+               dtype=jnp.float32) -> jax.Array:
+    """``q / scale`` back at ``dtype`` (the value-space inverse; the
+    rounding to the fp8 grid is of course not undone)."""
+    return (q.astype(jnp.float32) / scale).astype(dtype)
+
+
+def qdq(x: jax.Array, scale: jax.Array, dtype=FP8_E4M3) -> jax.Array:
+    """Quantize-dequantize: ``x`` rounded onto the fp8 grid, returned
+    at ``x.dtype`` — the emulation form for ops without an fp8-operand
+    lowering (convolutions); numerically identical operand rounding to
+    the real-fp8 dot, without requiring fp8 op support."""
+    return dequantize(quantize(x, scale, dtype), scale, x.dtype)
+
+
+def tensor_amax(x: jax.Array) -> jax.Array:
+    """``max(|x|)`` as f32 — the per-step history entry."""
+    return jnp.max(jnp.abs(x)).astype(jnp.float32)
+
+
+def scaled_matmul(x: jax.Array, w: jax.Array,
+                  x_scale: jax.Array, w_scale: jax.Array,
+                  dtype=FP8_E4M3,
+                  out_dtype=None) -> jax.Array:
+    """``x @ w`` with both operands cast to fp8 and **f32 accumulation**
+    via ``preferred_element_type`` — the scaled-matmul core.
+
+    The operands are quantized with their (delayed) scales, the dot
+    runs on the fp8 values, and the product of scales divides out of
+    the f32 accumulator once: ``(x*sx) @ (w*sw) / (sx*sw)``.  Output at
+    ``out_dtype`` (default: ``x.dtype`` — the network dtype, bf16 under
+    O4)."""
+    qx = quantize(x, x_scale, dtype)
+    qw = quantize(w, w_scale, dtype)
+    y = jnp.matmul(qx, qw, preferred_element_type=jnp.float32)
+    y = y / (x_scale * w_scale)
+    return y.astype(out_dtype if out_dtype is not None else x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def qdq_ste(x: jax.Array, scale: jax.Array, dtype=FP8_E4M3) -> jax.Array:
+    """:func:`qdq` with a straight-through gradient: the cotangent
+    passes UNROUNDED.  Differentiating through the raw casts instead
+    would round the cotangent onto the forward (e4m3) grid — jax
+    transposes ``convert`` as ``convert`` — on top of the deliberate
+    e5m2 rounding of :func:`bwd_qdq`, a double quantize the precision
+    lint's ``fp8-double-quantize`` rule caught on the first O4 lane
+    this package ever linted (kept as a seeded-bug regression test)."""
+    return qdq(x, scale, dtype)
+
+
+def _qdq_ste_fwd(x, scale, dtype):
+    return qdq(x, scale, dtype), scale
+
+
+def _qdq_ste_bwd(dtype, scale, g):
+    return g, jnp.zeros_like(scale)
+
+
+qdq_ste.defvjp(_qdq_ste_fwd, _qdq_ste_bwd)
+
+
+# ---------------------------------------------------------------------------
+# the e5m2 backward: a straight-through qdq on the cotangent
+# ---------------------------------------------------------------------------
+
+@jax.custom_vjp
+def _bwd_qdq(x: jax.Array, grad_scale: jax.Array) -> jax.Array:
+    """Identity forward; backward rounds the cotangent onto the e5m2
+    grid at ``grad_scale`` — how the O4 op layer puts real e5m2
+    converts on the gradient path without threading a second state
+    through every layer (the grad-class amax is recorded from the
+    materialized gradients at ``apply_gradients`` time instead, one
+    step lagged — delayed scaling either way)."""
+    return x
+
+
+def _bwd_qdq_fwd(x, grad_scale):
+    return x, grad_scale
+
+
+def _bwd_qdq_bwd(grad_scale, g):
+    return qdq(g, grad_scale, FP8_E5M2), jnp.zeros_like(grad_scale)
+
+
+_bwd_qdq.defvjp(_bwd_qdq_fwd, _bwd_qdq_bwd)
+
+
+def bwd_qdq(x: jax.Array, grad_scale: jax.Array) -> jax.Array:
+    """Public spelling of the e5m2 cotangent rounding point."""
+    return _bwd_qdq(x, grad_scale)
+
+
+# ---------------------------------------------------------------------------
+# the O4 train-state: three tensor classes, one pytree
+# ---------------------------------------------------------------------------
+
+class Fp8TrainState(NamedTuple):
+    """The fp8 state ``AmpState`` carries under O4 — one
+    :class:`DelayedScalingState` per tensor *class* (the granularity a
+    policy-level integration can own without knowing the model's
+    parameter tree; per-tensor states remain available to callers that
+    thread :class:`DelayedScalingState` themselves through
+    :func:`scaled_matmul`):
+
+    - ``input``: forward activations, e4m3;
+    - ``weight``: forward weights, e4m3;
+    - ``grad``: backward cotangents, e5m2 (amax recorded from the
+      step's materialized gradients — one step lagged, like every
+      other entry in the history).
+    """
+
+    input: DelayedScalingState
+    weight: DelayedScalingState
+    grad: DelayedScalingState
+
+
+def init_train_state(history_len: int = 16) -> Fp8TrainState:
+    return Fp8TrainState(input=init_delayed_scaling(history_len),
+                         weight=init_delayed_scaling(history_len),
+                         grad=init_delayed_scaling(history_len))
+
+
+def update_train_state(state: Fp8TrainState,
+                       amax_input: jax.Array,
+                       amax_weight: jax.Array,
+                       amax_grad: jax.Array,
+                       margin: int = 0) -> Fp8TrainState:
+    """End-of-step roll of all three classes (forward amaxes collected
+    by the op layer, grad amax from the unscaled gradients)."""
+    return Fp8TrainState(
+        input=record_amax(state.input, amax_input, FP8_E4M3, margin),
+        weight=record_amax(state.weight, amax_weight, FP8_E4M3, margin),
+        grad=record_amax(state.grad, amax_grad, FP8_E5M2, margin))
+
+
+def step_saturation(state: Fp8TrainState,
+                    amax_input: jax.Array,
+                    amax_weight: jax.Array,
+                    amax_grad: jax.Array,
+                    margin: int = 0) -> jax.Array:
+    """Dynamic-range utilization of the worst class THIS step: ``max
+    over classes of (this step's amax * the scale the step actually
+    quantized with / fp8_max)``.  ~1.0 is healthy (amaxes ride the top
+    of the representable range); > 1.0 means this step's values
+    exceeded what the delayed scale assumed and were CLIPPED at the
+    quantize — the amax-history-saturation signal the obs gauge
+    watches, computed against ``state`` BEFORE the end-of-step roll.
+    Non-finite amaxes (an overflowed, scaler-skipped backward) read
+    as 0 here like they record as 0 in the history."""
+    def _fin(a):
+        a = jnp.asarray(a, jnp.float32)
+        return jnp.where(jnp.isfinite(a), a, 0.0)
+    parts = [_fin(amax_input) * state.input.scale * (2.0 ** margin)
+             / fp8_max(FP8_E4M3),
+             _fin(amax_weight) * state.weight.scale * (2.0 ** margin)
+             / fp8_max(FP8_E4M3),
+             _fin(amax_grad) * state.grad.scale * (2.0 ** margin)
+             / fp8_max(FP8_E5M2)]
+    return jnp.max(jnp.stack(parts)).astype(jnp.float32)
+
+
+def rescale_events(old: Fp8TrainState, new: Fp8TrainState) -> jax.Array:
+    """How many classes' scales SHRANK this step (i32 0..3) — each one
+    an overflow-to-rescale event: the recorded amax exceeded what the
+    old history justified, forcing the delayed scale down."""
+    flags = [jnp.asarray(n.scale < o.scale, jnp.int32)
+             for o, n in zip(old, new)]
+    return jnp.sum(jnp.stack(flags))
+
+
+def tree_amax(tree: Any) -> jax.Array:
+    """``max(|leaf|)`` over every floating leaf of a pytree — the grad
+    class's history entry, computed from the step's own gradients (no
+    host sync: it's one more value on the device)."""
+    leaves = [jnp.max(jnp.abs(x)) for x in jax.tree.leaves(tree)
+              if hasattr(x, "dtype")
+              and jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)]
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    return jnp.max(jnp.stack(leaves)).astype(jnp.float32)

@@ -1,0 +1,95 @@
+"""Symmetric int8 quantization: per-channel weights + the KV-cache
+format the decode path reads.
+
+Decode is HBM-bandwidth-bound (DECODE_DECOMPOSE_r01: kv_read is 69% of
+the b8 step's modeled traffic), so the cache *dtype* is the ceiling
+knob: int8 KV halves the bytes per cached token vs bf16 — a ~2x lift
+of the decode roofline the bench's ``gpt_small_tpu_decode_kv8`` config
+derives from this module's byte model through
+:func:`apex_tpu.analysis.cost.roofline_expectation`.
+
+Format (the LLM.int8()-style absmax scheme, Dettmers et al., 2022,
+restricted to the symmetric per-vector case — no outlier
+decomposition, which matters for *weights* feeding matmuls, not for
+the attention cache):
+
+- **weights**: per-output-channel symmetric absmax —
+  ``q = round(w / s)`` with ``s = amax_channel / 127`` (f32 scales,
+  one per channel along ``axis``);
+- **KV cache**: per *token-slot* symmetric absmax — each cached token's
+  ``(H, D)`` key (or value) vector quantizes with its own f32 scale,
+  computed ON WRITE (one token, one reduction — this is dynamic
+  quantization, correct here because each slot is written exactly
+  once; the *delayed*-scale contract belongs to fp8 training where the
+  same class is re-quantized every step).  The scale array rides next
+  to the int8 pool (monolithic: ``(L, B, M)``; paged:
+  ``(L, num_blocks, block_size)``) and dequantization FUSES into the
+  attention read: the per-slot scale multiplies the (tiny) score /
+  probability tensors instead of re-materializing a dequantized cache
+  (see :func:`apex_tpu.models.generate._attn_cached`).
+
+Rounding is ``jnp.rint`` (round-half-to-even) with a clip to
+[-127, 127]; -128 is unused so the grid is symmetric and negation is
+exact.  Everything is deterministic — the decode-path tests pin
+bitwise-identical outputs across runs.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: symmetric int8 grid edge (|-128| is excluded on purpose)
+INT8_MAX = 127.0
+
+
+def quantize_int8(x: jax.Array, axis=None
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric absmax int8 quantization.
+
+    ``axis=None`` is per-tensor; an int/tuple quantizes per-channel
+    with the scale REDUCED OVER ``axis`` (so for a ``(K, N)`` weight
+    quantized per output channel, pass ``axis=0`` and get ``(1, N)``
+    scales).  Returns ``(q int8, scale f32)`` with
+    ``x ≈ q * scale``; an all-zero vector gets scale 1 (and zeros)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis,
+                   keepdims=axis is not None)
+    scale = jnp.where(amax > 0.0, amax / INT8_MAX, 1.0)
+    q = jnp.clip(jnp.rint(x.astype(jnp.float32) / scale),
+                 -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_int8(q: jax.Array, scale: jax.Array,
+                    dtype=jnp.float32) -> jax.Array:
+    """``q * scale`` at ``dtype``."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def quantize_kv(kv: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Quantize a K or V write ``(..., H, D)`` with one scale per
+    leading position — per token-slot absmax over the trailing two
+    (head, dim) axes.  Returns ``(q int8 (..., H, D),
+    scales f32 (...,))`` — the write-side half of the int8 KV format;
+    the read side folds the scales into the attention math
+    (:func:`kv_dequant_scales` documents the exactness argument)."""
+    amax = jnp.max(jnp.abs(kv.astype(jnp.float32)), axis=(-2, -1))
+    scale = jnp.where(amax > 0.0, amax / INT8_MAX, 1.0)
+    q = jnp.clip(jnp.rint(kv.astype(jnp.float32) / scale[..., None, None]),
+                 -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def kv_dequant_scales(scale: jax.Array) -> jax.Array:
+    """The per-position dequant factors to fold into the attention
+    read.  Because the scale is constant over the contracted ``(H, D)``
+    axes, ``sum_d q[d]*s*x[d] == s * sum_d q[d]*x[d]`` EXACTLY in real
+    arithmetic — dequantization commutes with the dot, so multiplying
+    the per-position scores (K side) or probability weights (V side)
+    by ``s`` is the fused form of dequantizing the cache.  (In float
+    arithmetic the two orderings can differ in the last ulp; the decode
+    tests bound the int8-vs-f32 error as a whole, and bitwise
+    determinism is across RUNS of the same program, which this is.)"""
+    return scale.astype(jnp.float32)

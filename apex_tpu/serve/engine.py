@@ -76,9 +76,22 @@ class ServeConfig:
     prefill_chunk: int = 16
     kv_dtype: Optional[Any] = None
 
+    @property
+    def int8_kv(self) -> bool:
+        """True when ``kv_dtype`` selects the int8 KV format ("int8"
+        or ``jnp.int8``): int8 pools + per-position f32 scale pools,
+        quantize-on-write, dequant fused into the attention read
+        (:mod:`apex_tpu.quant.int8`) — half the cache bytes of bf16,
+        the ~2x lift of the HBM-bound decode ceiling."""
+        if self.kv_dtype is None:
+            return False
+        if isinstance(self.kv_dtype, str):
+            return self.kv_dtype == "int8"
+        return jnp.dtype(self.kv_dtype) == jnp.int8
+
 
 def _paged_block(x, p_l, cfg: GPTConfig, kc, vc, layer_i, cos, sin,
-                 blocks, offs, table, valid, scale):
+                 blocks, offs, table, valid, scale, ks=None, vs=None):
     """One transformer block over ``x (B, Lq, E)`` reading/writing the
     paged pools — op-for-op the math of
     :func:`apex_tpu.models.generate._block` (the bitwise-parity
@@ -87,7 +100,8 @@ def _paged_block(x, p_l, cfg: GPTConfig, kc, vc, layer_i, cos, sin,
     it at ``(B=num_slots, Lq=1)``, the prefill chunk at ``(B=1,
     Lq=chunk)``; either way the per-token write coordinates are the
     flattened ``blocks``/``offs`` ``(B*Lq,)`` and ``valid`` is the
-    ``(B, Lq, M)`` causal-vs-cache mask."""
+    ``(B, Lq, M)`` causal-vs-cache mask.  ``ks``/``vs`` are the int8
+    format's ``(L, num_blocks, bs)`` scale pools (None = dense)."""
     c = cfg
     head_dim = c.hidden_size // c.num_heads
     b, lq = x.shape[0], x.shape[1]
@@ -100,17 +114,48 @@ def _paged_block(x, p_l, cfg: GPTConfig, kc, vc, layer_i, cos, sin,
     v = v.reshape(b, lq, c.num_heads, head_dim)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    kc = kc.at[layer_i, blocks, offs].set(
-        k.reshape(b * lq, c.num_heads, head_dim).astype(kc.dtype))
-    vc = vc.at[layer_i, blocks, offs].set(
-        v.reshape(b * lq, c.num_heads, head_dim).astype(vc.dtype))
+    kg_scale = vg_scale = None
+    err = None
+    if ks is not None:
+        from apex_tpu.quant import int8 as int8_lib
+        qk, sk = int8_lib.quantize_kv(
+            k.reshape(b * lq, c.num_heads, head_dim))
+        qv, sv = int8_lib.quantize_kv(
+            v.reshape(b * lq, c.num_heads, head_dim))
+        # per-write relative quantization error — the admission-time
+        # KV-quality gauge's raw signal (device scalar; resolved with
+        # lag by the registry, never a host sync on the step path)
+        kf = k.reshape(b * lq, c.num_heads, head_dim).astype(jnp.float32)
+        vf = v.reshape(b * lq, c.num_heads, head_dim).astype(jnp.float32)
+        num = (jnp.mean(jnp.abs(
+                   kf - int8_lib.dequantize_int8(qk, sk[:, None, None])))
+               + jnp.mean(jnp.abs(
+                   vf - int8_lib.dequantize_int8(qv, sv[:, None, None]))))
+        den = jnp.mean(jnp.abs(kf)) + jnp.mean(jnp.abs(vf)) + 1e-12
+        err = num / den
+        kc = kc.at[layer_i, blocks, offs].set(qk)
+        vc = vc.at[layer_i, blocks, offs].set(qv)
+        ks = ks.at[layer_i, blocks, offs].set(sk)
+        vs = vs.at[layer_i, blocks, offs].set(sv)
+        kg_scale = paged.gather_slot_scales(
+            jax.lax.dynamic_index_in_dim(ks, layer_i, 0,
+                                         keepdims=False), table)
+        vg_scale = paged.gather_slot_scales(
+            jax.lax.dynamic_index_in_dim(vs, layer_i, 0,
+                                         keepdims=False), table)
+    else:
+        kc = kc.at[layer_i, blocks, offs].set(
+            k.reshape(b * lq, c.num_heads, head_dim).astype(kc.dtype))
+        vc = vc.at[layer_i, blocks, offs].set(
+            v.reshape(b * lq, c.num_heads, head_dim).astype(vc.dtype))
     kg = paged.gather_slot_kv(
         jax.lax.dynamic_index_in_dim(kc, layer_i, 0, keepdims=False),
         table)
     vg = paged.gather_slot_kv(
         jax.lax.dynamic_index_in_dim(vc, layer_i, 0, keepdims=False),
         table)
-    o = paged.paged_attention(q, kg, vg, valid, scale)
+    o = paged.paged_attention(q, kg, vg, valid, scale,
+                              k_scale=kg_scale, v_scale=vg_scale)
     o = o.reshape(b, lq, c.hidden_size)
     x = x + (o @ p_l["attention"]["out"]["kernel"]
              + p_l["attention"]["out"]["bias"].astype(o.dtype))
@@ -120,7 +165,7 @@ def _paged_block(x, p_l, cfg: GPTConfig, kc, vc, layer_i, cos, sin,
     h = jax.nn.gelu(h)
     x = x + (h @ p_l["ffn_out"]["kernel"]
              + p_l["ffn_out"]["bias"].astype(h.dtype))
-    return x, kc, vc
+    return x, kc, vc, ks, vs, err
 
 
 class ServeEngine:
@@ -168,13 +213,29 @@ class ServeEngine:
         self.top = {k: v for k, v in params.items()
                     if not k.startswith("block_") and k != "layers"}
         dtype = self.top["tok_emb"]["embedding"].dtype
-        kv_dtype = serve_cfg.kv_dtype or dtype
         head_dim = cfg.hidden_size // cfg.num_heads
-        kc, vc = paged.make_pools(cfg.num_layers, serve_cfg.num_blocks,
-                                  serve_cfg.block_size, cfg.num_heads,
-                                  head_dim, kv_dtype)
         keys = jnp.zeros((serve_cfg.num_slots, 2), jnp.uint32)
-        self.carry = {"kc": kc, "vc": vc, "keys": keys}
+        if serve_cfg.int8_kv:
+            kc, vc = paged.make_pools(
+                cfg.num_layers, serve_cfg.num_blocks,
+                serve_cfg.block_size, cfg.num_heads, head_dim, jnp.int8)
+            ks, vs = paged.make_scale_pools(
+                cfg.num_layers, serve_cfg.num_blocks,
+                serve_cfg.block_size)
+            self.carry = {"kc": kc, "vc": vc, "ks": ks, "vs": vs,
+                          "keys": keys}
+            self._m_kv_err = self.metrics.gauge(
+                "serve_kv_quant_error",
+                "relative int8 KV quantization error of the latest "
+                "admitted prefill chunk (mean |x - deq(q(x))| / "
+                "mean |x| over K and V; device value, lag-resolved)")
+        else:
+            kv_dtype = serve_cfg.kv_dtype or dtype
+            kc, vc = paged.make_pools(
+                cfg.num_layers, serve_cfg.num_blocks,
+                serve_cfg.block_size, cfg.num_heads, head_dim, kv_dtype)
+            self.carry = {"kc": kc, "vc": vc, "keys": keys}
+            self._m_kv_err = None
         #: python-body executions of each traced function — a retrace
         #: (shape drift across admit/retire) increments these past 1;
         #: tests assert they stay there across a whole mixed stream
@@ -182,7 +243,7 @@ class ServeEngine:
         self._decode_step = jax.jit(self._decode_body,
                                     donate_argnums=(2,))
         self._prefill_chunk = jax.jit(self._prefill_body,
-                                      donate_argnums=(2, 3))
+                                      donate_argnums=(2, 3, 4, 5))
         self._sample_one = jax.jit(self._sample1_body)
         self._outputs: Dict[str, np.ndarray] = {}
 
@@ -211,6 +272,7 @@ class ServeEngine:
         c = self.cfg
         bs = self.scfg.block_size
         kc, vc, keys = carry["kc"], carry["vc"], carry["keys"]
+        ks, vs = carry.get("ks"), carry.get("vs")
         head_dim = c.hidden_size // c.num_heads
         scale = 1.0 / float(head_dim) ** 0.5
         s = tokens.shape[0]
@@ -228,36 +290,44 @@ class ServeEngine:
         valid = valid[:, None, :]                              # (S,1,M)
 
         def layer(lcarry, inputs):
-            x, kc, vc = lcarry
+            x, kc, vc, ks, vs = lcarry
             p_l, layer_i = inputs
-            x, kc, vc = _paged_block(x, p_l, c, kc, vc, layer_i, cos,
-                                     sin, blocks, offs, page_table,
-                                     valid, scale)
-            return (x, kc, vc), None
+            x, kc, vc, ks, vs, _err = _paged_block(
+                x, p_l, c, kc, vc, layer_i, cos, sin, blocks, offs,
+                page_table, valid, scale, ks=ks, vs=vs)
+            return (x, kc, vc, ks, vs), None
 
-        (x, kc, vc), _ = jax.lax.scan(
-            layer, (x, kc, vc), (stacked, jnp.arange(c.num_layers)))
+        (x, kc, vc, ks, vs), _ = jax.lax.scan(
+            layer, (x, kc, vc, ks, vs),
+            (stacked, jnp.arange(c.num_layers)))
         x = _ln(x[:, -1:], top["ln_f"], c.layer_norm_eps)
         logits = x[:, 0] @ top["lm_head"]["kernel"]            # (S,V)
         toks, new_keys = sampling.sample_tokens(logits, keys, temp,
                                                 top_k, top_p)
         toks = jnp.where(active, toks, tokens)
-        return {"kc": kc, "vc": vc, "keys": new_keys}, toks
+        out = {"kc": kc, "vc": vc, "keys": new_keys}
+        if ks is not None:
+            out["ks"], out["vs"] = ks, vs
+        return out, toks
 
-    def _prefill_body(self, top, stacked, kc, vc, table_row, chunk_ids,
-                      start, n_valid):
+    def _prefill_body(self, top, stacked, kc, vc, ks, vs, table_row,
+                      chunk_ids, start, n_valid):
         """Write one ``(1, prefill_chunk)`` prompt chunk of a single
         slot through its page table at global positions ``start..`` and
-        return ``(kc, vc, last-valid-token logits (1, V))``.  Rows past
-        ``n_valid`` are padding: their cache writes route to the trash
-        block and their outputs are never read."""
+        return ``(kc, vc, ks, vs, last-valid-token logits (1, V),
+        kv_err)``.  Rows past ``n_valid`` are padding: their cache
+        writes route to the trash block and their outputs are never
+        read.  ``kv_err`` is the layer-mean relative int8 quantization
+        error of this chunk's writes (0 under a dense cache) — the
+        admission-time KV-quality gauge's device value."""
         self.trace_counts["prefill"] += 1
         with spans.span("serve/prefill_chunk", registry=self.metrics):
-            return self._prefill_math(top, stacked, kc, vc, table_row,
-                                      chunk_ids, start, n_valid)
+            return self._prefill_math(top, stacked, kc, vc, ks, vs,
+                                      table_row, chunk_ids, start,
+                                      n_valid)
 
-    def _prefill_math(self, top, stacked, kc, vc, table_row, chunk_ids,
-                      start, n_valid):
+    def _prefill_math(self, top, stacked, kc, vc, ks, vs, table_row,
+                      chunk_ids, start, n_valid):
         c = self.cfg
         bs = self.scfg.block_size
         mb = self.scfg.max_blocks_per_slot
@@ -279,19 +349,21 @@ class ServeEngine:
         valid = (jnp.arange(m)[None, :] <= pos[:, None])[None]  # (1,C,M)
 
         def layer(lcarry, inputs):
-            x, kc, vc = lcarry
+            x, kc, vc, ks, vs, esum = lcarry
             p_l, layer_i = inputs
-            x, kc, vc = _paged_block(x, p_l, c, kc, vc, layer_i, cos,
-                                     sin, blocks, offs,
-                                     table_row[None], valid, scale)
-            return (x, kc, vc), None
+            x, kc, vc, ks, vs, err = _paged_block(
+                x, p_l, c, kc, vc, layer_i, cos, sin, blocks, offs,
+                table_row[None], valid, scale, ks=ks, vs=vs)
+            esum = esum + (err if err is not None else 0.0)
+            return (x, kc, vc, ks, vs, esum), None
 
-        (x, kc, vc), _ = jax.lax.scan(
-            layer, (x, kc, vc), (stacked, jnp.arange(c.num_layers)))
+        (x, kc, vc, ks, vs, esum), _ = jax.lax.scan(
+            layer, (x, kc, vc, ks, vs, jnp.asarray(0.0, jnp.float32)),
+            (stacked, jnp.arange(c.num_layers)))
         x_last = jax.lax.dynamic_slice_in_dim(x, n_valid - 1, 1, axis=1)
         x_last = _ln(x_last, top["ln_f"], c.layer_norm_eps)
         logits = x_last[:, 0] @ top["lm_head"]["kernel"]       # (1,V)
-        return kc, vc, logits
+        return kc, vc, ks, vs, logits, esum / c.num_layers
 
     # -- host loop -----------------------------------------------------
 
@@ -306,14 +378,21 @@ class ServeEngine:
         padded[:n] = prompt
         table_row = jnp.asarray(self.sched.page_table[slot])
         kc, vc = self.carry["kc"], self.carry["vc"]
+        ks, vs = self.carry.get("ks"), self.carry.get("vs")
         logits = None
+        kv_err = None
         for j in range(0, len(padded), c):
             n_valid = min(c, n - j)
-            kc, vc, logits = self._prefill_chunk(
-                self.top, self.stacked, kc, vc, table_row,
+            kc, vc, ks, vs, logits, kv_err = self._prefill_chunk(
+                self.top, self.stacked, kc, vc, ks, vs, table_row,
                 jnp.asarray(padded[None, j:j + c]),
                 jnp.int32(j), jnp.int32(n_valid))
             self._m_prefill.inc()
+        if self._m_kv_err is not None and kv_err is not None:
+            # admission-time KV quantization-error gauge: a DEFERRED
+            # device value resolved by the registry's lag machinery at
+            # the next tick — no host sync added here
+            self._m_kv_err.set(kv_err)
         if req.resume_key is not None:
             key = jnp.asarray(req.resume_key, jnp.uint32)[None]
         else:
@@ -325,6 +404,8 @@ class ServeEngine:
             jnp.full((1,), req.top_p, jnp.float32))
         keys = self.carry["keys"].at[slot].set(new_key[0])
         self.carry = {"kc": kc, "vc": vc, "keys": keys}
+        if ks is not None:
+            self.carry["ks"], self.carry["vs"] = ks, vs
         self.sched.arm(slot, int(np.asarray(tok)[0]), n)
         self._m_tokens.inc(1)          # the prefill's sampled token
         # a 1-token budget (or an immediate EOS) finishes on the
@@ -379,6 +460,10 @@ class ServeEngine:
                 uid, out = sched.retire(slot)
                 finished[uid] = out
         self._outputs.update(finished)
+        # step boundary for the registry's lag machinery: deferred
+        # device values (the int8 KV admission gauge) resolve in
+        # batched fetches >= 1 step behind dispatch — zero added syncs
+        self.metrics.tick()
         return finished
 
     def run(self, max_steps: int = 100_000) -> Dict[str, np.ndarray]:

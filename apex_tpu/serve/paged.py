@@ -120,6 +120,16 @@ def make_pools(num_layers: int, num_blocks: int, block_size: int,
     return kc, jnp.zeros_like(kc)
 
 
+def make_scale_pools(num_layers: int, num_blocks: int, block_size: int):
+    """Zeroed ``(ks, vs)`` per-position scale pools
+    ``(L, num_blocks, block_size)`` f32 — the int8 KV format's
+    companion to :func:`make_pools` (one absmax scale per cached
+    token-slot per layer; unwritten slots dequantize to exact zeros)."""
+    shape = (num_layers, num_blocks, block_size)
+    ks = jnp.zeros(shape, jnp.float32)
+    return ks, jnp.zeros_like(ks)
+
+
 def gather_slot_kv(pool_l: jax.Array, page_table: jax.Array) -> jax.Array:
     """Linearize every slot's cache through its page table:
     ``pool_l (num_blocks, bs, H, D)`` gathered by ``page_table (S,
@@ -129,6 +139,17 @@ def gather_slot_kv(pool_l: jax.Array, page_table: jax.Array) -> jax.Array:
     g = pool_l[page_table]                   # (S, MB, bs, H, D)
     s, mb, bs, h, d = g.shape
     return g.reshape(s, mb * bs, h, d)
+
+
+def gather_slot_scales(pool_s: jax.Array,
+                       page_table: jax.Array) -> jax.Array:
+    """Linearize the per-position scale pool the same way:
+    ``pool_s (num_blocks, bs)`` gathered by ``page_table (S,
+    max_blocks)`` → ``(S, max_blocks*bs)`` — scale ``[s, p]`` belongs
+    to cache position ``[s, p]`` of :func:`gather_slot_kv`'s output."""
+    g = pool_s[page_table]                   # (S, MB, bs)
+    s, mb, bs = g.shape
+    return g.reshape(s, mb * bs)
 
 
 def token_write_coords(lengths: jax.Array, page_table: jax.Array,
@@ -144,12 +165,16 @@ def token_write_coords(lengths: jax.Array, page_table: jax.Array,
 
 
 def paged_attention(q: jax.Array, k_lin: jax.Array, v_lin: jax.Array,
-                    valid: jax.Array, scale: float) -> jax.Array:
+                    valid: jax.Array, scale: float,
+                    k_scale=None, v_scale=None) -> jax.Array:
     """fp32-softmax attention of ``q (S, Lq, H, D)`` against the
     linearized per-slot caches ``(S, M, H, D)`` under the boolean mask
     ``valid (S, Lq, M)`` (True = attend; a per-slot batch dim so every
     slot attends to its own live length).  Delegates to
     :func:`apex_tpu.models.generate._attn_cached` — the serve-vs-solo
-    bitwise-parity contract requires the math to exist exactly once."""
+    bitwise-parity contract requires the math to exist exactly once.
+    ``k_scale``/``v_scale`` ``(S, M)`` are the int8 KV format's
+    per-position dequant scales (from :func:`gather_slot_scales`)."""
     from apex_tpu.models.generate import _attn_cached
-    return _attn_cached(q, k_lin, v_lin, valid, scale)
+    return _attn_cached(q, k_lin, v_lin, valid, scale,
+                        k_scale=k_scale, v_scale=v_scale)

@@ -474,7 +474,8 @@ def chip_hbm_bytes_per_s() -> float:
 
 
 def bench_generate(batch: int, prefill: int, new_tokens: int, warmup: int,
-                   iters: int, peak: float, tiny: bool = False):
+                   iters: int, peak: float, tiny: bool = False,
+                   kv_dtype=None):
     """KV-cached decode throughput (``apex_tpu.models.generate``):
     greedy generation of ``new_tokens`` after a ``prefill``-token prompt
     on gpt-small (TPU head geometry), bf16 params.
@@ -496,7 +497,19 @@ def bench_generate(batch: int, prefill: int, new_tokens: int, warmup: int,
     tracked efficiency metric against a fixed bar, not a claim that
     0.57 of the bandwidth is idle.  ``tok_s`` counts NEW tokens only;
     the one prefill forward per call is amortized into the measured
-    window exactly as a serving loop would pay it."""
+    window exactly as a serving loop would pay it.
+
+    ``kv_dtype="int8"`` selects the int8 KV cache
+    (:mod:`apex_tpu.quant.int8`: per-position absmax scales, dequant
+    fused into the attention read) and the byte model follows — 1
+    byte/element for both caches plus 4 bytes/position/layer for each
+    scale array instead of 2 bytes/element, so the ceiling this config
+    is gated against (``gpt_small_tpu_decode_kv8``) is DERIVED from
+    the int8 byte model through the same
+    :func:`~apex_tpu.analysis.cost.roofline_expectation` call, never
+    hand-written: decode is HBM-bound with kv_read the dominant term
+    (DECODE_DECOMPOSE_r01), so halving cache bytes is a ~2x ceiling
+    lift at long context."""
     from apex_tpu import amp
     from apex_tpu.models.generate import generate
     from apex_tpu.models.gpt import GPTModel, gpt_small_tpu, gpt_tiny
@@ -510,14 +523,14 @@ def bench_generate(batch: int, prefill: int, new_tokens: int, warmup: int,
     params = a.model_params_from(params)  # bf16, the serving layout
 
     import numpy as np
-    out = generate(params, cfg, prompt, new_tokens)
+    out = generate(params, cfg, prompt, new_tokens, kv_dtype=kv_dtype)
     np.asarray(out[:, -1])  # compile + drain (scalar fetch, not BUR)
     for _ in range(warmup):
-        out = generate(params, cfg, prompt, new_tokens)
+        out = generate(params, cfg, prompt, new_tokens, kv_dtype=kv_dtype)
     np.asarray(out[:, -1])
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = generate(params, cfg, prompt, new_tokens)
+        out = generate(params, cfg, prompt, new_tokens, kv_dtype=kv_dtype)
     np.asarray(out[:, -1])
     dt = time.perf_counter() - t0
 
@@ -526,7 +539,15 @@ def bench_generate(batch: int, prefill: int, new_tokens: int, warmup: int,
     n_params = sum(int(p.size) for p in jax.tree.leaves(params))
     head_dim = cfg.hidden_size // cfg.num_heads
     m = prefill + new_tokens
-    cache_b = 2 * cfg.num_layers * batch * m * cfg.num_heads * head_dim * 2
+    if kv_dtype == "int8":
+        # int8 KV byte model: 1 byte/element per cache + one f32 scale
+        # per cached position per layer for each of K and V
+        cache_b = (2 * cfg.num_layers * batch * m * cfg.num_heads
+                   * head_dim * 1
+                   + 2 * cfg.num_layers * batch * m * 4)
+    else:
+        cache_b = (2 * cfg.num_layers * batch * m * cfg.num_heads
+                   * head_dim * 2)
     bytes_per_step = 2 * n_params + cache_b   # bf16 params + k&v caches
     # dense-matmul flops of one step (2 flops/param/token x batch):
     # the numerator of the shared roofline — decode intensity is ~0.01
@@ -540,12 +561,16 @@ def bench_generate(batch: int, prefill: int, new_tokens: int, warmup: int,
         flops_per_step, bytes_per_step,
         peak_flops=peak or float("inf"), peak_hbm_bytes_per_s=bw)
     ceiling = batch * exp["ceiling_flops_per_s"] / flops_per_step
-    return {"tok_s": round(batch * new_tokens * iters / dt, 2),
-            "batch": batch, "prefill": prefill, "new_tokens": new_tokens,
-            "params": n_params, "bound": exp["bound"],
-            "hbm_tok_s_ceiling": round(ceiling, 2),
-            "hbm_frac": round(batch * new_tokens * iters / dt / ceiling,
-                              4)}
+    rec = {"tok_s": round(batch * new_tokens * iters / dt, 2),
+           "batch": batch, "prefill": prefill, "new_tokens": new_tokens,
+           "params": n_params, "bound": exp["bound"],
+           "hbm_tok_s_ceiling": round(ceiling, 2),
+           "hbm_frac": round(batch * new_tokens * iters / dt / ceiling,
+                             4)}
+    if kv_dtype is not None:
+        rec["kv_dtype"] = kv_dtype
+        rec["cache_bytes_per_step"] = int(cache_b)
+    return rec
 
 
 def bench_serve(warmup: int, iters: int, peak: float,
@@ -795,6 +820,15 @@ MFU_FLOORS = {
 DECODE_FLOORS = {
     "gpt_small_tpu_decode_b1": 0.54,
     "gpt_small_tpu_decode_b8": 0.43,
+    # int8-KV b8 config: the ceiling itself is derived from the int8
+    # byte model (cache term halves: ~1.6x the dense-config ceiling at
+    # b8/2048+256, approaching 2x as context grows and kv_read
+    # dominates), so the same hbm_frac would mean ~1.6x the tokens/s.
+    # Floor seeded from the CPU-smoke measurement (hbm_frac 0.0011 vs
+    # the TPU roofline — a catastrophic-regression guard only); the
+    # first on-chip round ratchets it to the measured value per the
+    # no-ratchet-down house rule (raising is always allowed).
+    "gpt_small_tpu_decode_kv8": 0.001,
 }
 
 
@@ -1256,6 +1290,15 @@ def main(argv=None):
         record("gpt_small_tpu_decode_b8", bench_generate, optional=True,
                batch=8, prefill=2048, new_tokens=256, warmup=1, iters=4,
                tiny=False)
+        # int8 KV cache variant of the b8 decode config: half the
+        # cache bytes -> the ceiling (derived from the int8 byte model
+        # via roofline_expectation inside bench_generate) nearly
+        # doubles at this context length; hbm_frac is gated by its own
+        # DECODE_FLOORS entry (CPU-smoke-seeded; on-chip ratchet next
+        # driver round)
+        record("gpt_small_tpu_decode_kv8", bench_generate, optional=True,
+               batch=8, prefill=2048, new_tokens=256, warmup=1, iters=4,
+               tiny=False, kv_dtype="int8")
         # continuous-batching serve engine (apex_tpu.serve): offered-
         # load sweep c1 -> c8 over the paged KV cache, decode-step
         # p50/p99 latency + tokens/s; the latency-tail ab gate catches

@@ -363,3 +363,64 @@ def test_repo_obs_and_profile_validate():
     schemas' reference instances; they must stay valid."""
     assert gate_hygiene._validate_obs(str(REPO)) == []
     assert gate_hygiene._validate_profiles(str(REPO)) == []
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 9: CONVERGENCE_r*.json schema validation
+# ---------------------------------------------------------------------------
+
+def _valid_convergence():
+    return {"platform": "cpu", "all_ok": True,
+            "o4_mnist": {"name": "o4_mnist", "ok": True},
+            "int8_kv_decode": {"name": "int8_kv_decode", "ok": True},
+            "anchors": {"ngram1_nats_per_byte": 3.15}}
+
+
+def test_committed_convergence_validated_against_schema(tmp_repo):
+    _analysis_module(tmp_repo, "convergence")
+    (tmp_repo / "CONVERGENCE_r07_bad.json").write_text('{"x": 1}')
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "bad convergence")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("CONVERGENCE_r07_bad.json" in p
+               for p in verdict["invalid_convergences"])
+    assert gate_hygiene.main(["--repo", str(tmp_repo)]) == 1
+
+
+def test_convergence_all_ok_must_match_lanes(tmp_repo):
+    """all_ok contradicting the lanes' ok flags is schema-invalid (the
+    verdict must be derivable from the document alone); a consistent
+    document — and the legacy round-2 single-record shape — pass."""
+    _analysis_module(tmp_repo, "convergence")
+    bad = _valid_convergence()
+    bad["o4_mnist"]["ok"] = False           # all_ok still True
+    (tmp_repo / "CONVERGENCE_r08_lie.json").write_text(json.dumps(bad))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "contradictory convergence")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert any("contradicts" in p
+               for p in verdict["invalid_convergences"])
+
+    good = _valid_convergence()
+    legacy = {"platform": "tpu", "ok": True, "epochs": 3}
+    (tmp_repo / "CONVERGENCE_r08_lie.json").write_text(json.dumps(good))
+    (tmp_repo / "CONVERGENCE_r02_legacy.json").write_text(
+        json.dumps(legacy))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "good convergence")
+    assert gate_hygiene.check(str(tmp_repo))["ok"]
+
+
+def test_real_committed_convergence_artifacts_validate():
+    """Every CONVERGENCE_r*.json in the real repo — the legacy r02
+    shape through the r06 quant lanes — validates."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_conv_schema", REPO / "apex_tpu" / "analysis" / "convergence.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    arts = sorted(REPO.glob("CONVERGENCE_r*.json"))
+    assert len(arts) >= 5
+    for p in arts:
+        assert mod.validate_convergence_file(str(p)) == [], p.name

@@ -599,3 +599,44 @@ def test_flagship_entry_forward_lints_clean():
         options={"memory": {"budget_bytes": 16 << 30},
                  "collectives": {"budget": {"total": 0}}})
     assert rep.ok, rep.format()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 9: the O4 (fp8) train lane and the int8-KV decode lane
+# ---------------------------------------------------------------------------
+
+def test_cli_o4_lane_full_matrix_clean(capsys):
+    """The fp8 regime's train step — delayed-scaling state donated in
+    AmpState, e4m3/e5m2 quantizes in the program — lints clean under
+    the FULL pass matrix with the memory budget armed: donation covers
+    the fp8 leaves, the syncs pass proves the instrumented-metrics
+    design added no host sync, and the precision pass carries the
+    three fp8 rules."""
+    import graph_lint
+    assert graph_lint.main(["--families", "mlp", "--lanes", "o4",
+                            "--memory-budget"]) == 0
+    out = capsys.readouterr().out
+    rec = json.loads([line for line in out.splitlines()
+                      if '"lane": "mlp_o4"' in line][0])
+    assert rec["ok"]
+    assert {"donation", "memory", "syncs", "precision"} \
+        <= set(rec["passes"])
+
+
+def test_cli_decode_kv8_lane_dispatch(capsys):
+    """``--lanes decode`` dispatches the int8-KV lane alongside the
+    dense ones (cheap lowering-only precision run)."""
+    import graph_lint
+    assert graph_lint.main(["--families", "mlp", "--lanes", "decode",
+                            "--passes", "precision"]) == 0
+    out = capsys.readouterr().out
+    assert '"lane": "decode_b1_kv8"' in out
+    rec = json.loads([line for line in out.splitlines()
+                      if '"lane": "decode_b1_kv8"' in line][0])
+    assert rec["ok"]
+
+
+def test_decode_lanes_table_carries_kv8():
+    import graph_lint
+    assert graph_lint.DECODE_LANES["decode_b1_kv8"][3] == "int8"
+    assert "o4" in graph_lint.TRAIN_LANES

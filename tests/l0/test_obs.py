@@ -176,6 +176,28 @@ def test_instrument_step_wraps_and_lags():
     assert reg.counter("train_overflows_total").value == 0.0
 
 
+def test_instrument_step_records_fp8_metrics_lagged():
+    """The O4 regime's telemetry (amax-saturation gauge,
+    overflow-to-rescale counter) rides the SAME deferred/lag machinery
+    as loss/overflow — device values recorded at the step boundary,
+    resolved by tick/flush, never a fresh host sync."""
+    reg = obs_metrics.Registry()
+
+    def step(state, x):
+        return state + 1, {"loss": jnp.float32(0.1),
+                           "overflow": jnp.asarray(False),
+                           "fp8_amax_saturation": jnp.float32(0.97),
+                           "fp8_rescales": jnp.asarray(2, jnp.int32)}
+
+    wrapped = obs_metrics.instrument_step(step, registry=reg)
+    s = 0
+    for i in range(3):
+        s, _m = wrapped(s, i)
+    reg.flush()
+    assert reg.gauge("train_fp8_amax_saturation").value ==         jnp.float32(0.97)
+    assert reg.counter("train_fp8_rescales_total").value == 6.0
+
+
 # ---------------------------------------------------------------------------
 # export goldens
 # ---------------------------------------------------------------------------

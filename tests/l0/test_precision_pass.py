@@ -358,3 +358,142 @@ def test_preclint_schema_rejects_contradictory_verdict():
     assert any("contradicts" in p for p in validate_preclint(doc))
     doc["lanes"]["mlp_o1_train"] = _lane(ok=False, errors=0)
     assert any("contradicts" in p for p in validate_preclint(doc))
+
+
+# ---------------------------------------------------------------------------
+# the fp8 contract (ISSUE 9): each rule fires on a seeded bug and stays
+# quiet on the correct delayed-scaling spelling and the real O4 lanes
+# ---------------------------------------------------------------------------
+
+def _fp8_errs(rep):
+    return [f.op for f in rep.findings if f.severity == "error"]
+
+
+def test_seeded_same_step_scale_fires():
+    """Quantizing with a scale derived from THIS step's amax — the
+    anti-pattern delayed scaling exists to forbid."""
+    def bad(x):
+        amax = jnp.max(jnp.abs(x))
+        s = 448.0 / jnp.maximum(amax, 1e-30)
+        q = jnp.clip(x * s, -448., 448.).astype(jnp.float8_e4m3fn)
+        return (q.astype(jnp.float32) / s).sum()
+
+    rep = _run(bad, jnp.ones((64,)))
+    assert "fp8-same-step-scale" in _fp8_errs(rep)
+
+
+def test_seeded_double_quantize_fires():
+    """Dequantize-then-requantize through a pure value chain: two
+    roundings, two composed scales."""
+    def bad(x, s1, s2):
+        q1 = jnp.clip(x * s1, -448., 448.).astype(jnp.float8_e4m3fn)
+        d = q1.astype(jnp.float32) / s1
+        q2 = jnp.clip(d * s2, -448., 448.).astype(jnp.float8_e4m3fn)
+        return (q2.astype(jnp.float32) / s2).sum()
+
+    rep = _run(bad, jnp.ones((64,)), jnp.float32(2.0), jnp.float32(3.0))
+    assert "fp8-double-quantize" in _fp8_errs(rep)
+
+
+def test_seeded_amax_unrecorded_fires_under_fp8_policy():
+    """Under the O4 policy, quantizing without ever rolling an amax
+    into the carried state leaves the delayed scale free-running."""
+    from apex_tpu.quant import fp8 as fp8_lib
+
+    def bad(x, scale):
+        q = fp8_lib.quantize(x, scale)
+        return (q.astype(jnp.float32) / scale).sum()
+
+    rep = _run(bad, jnp.ones((64,)), jnp.float32(2.0),
+               policy=amp.resolve("O4"))
+    assert "fp8-amax-unrecorded" in _fp8_errs(rep)
+
+
+def test_correct_delayed_scaling_spelling_is_quiet():
+    """quantize with the CARRIED scale + record_amax flowing to the
+    output: the in-tree spelling, clean under the O4 policy."""
+    from apex_tpu.quant import fp8 as fp8_lib
+
+    def good(x, state):
+        q = fp8_lib.quantize(x, state.scale)
+        y = (q.astype(jnp.float32) / state.scale).sum()
+        new = fp8_lib.record_amax(state, fp8_lib.tensor_amax(x),
+                                  fp8_lib.FP8_E4M3)
+        return y, new
+
+    rep = _run(good, jnp.ones((64,)), fp8_lib.init_delayed_scaling(4),
+               policy=amp.resolve("O4"))
+    assert rep.ok, rep.format()
+
+
+def test_int8_kv_quantization_is_exempt():
+    """The int8 KV format's per-write dynamic scale is the documented
+    design — converts target i8, so no fp8 rule may fire."""
+    def int8_write(k):
+        amax = jnp.max(jnp.abs(k), axis=(-2, -1))
+        s = jnp.where(amax > 0, amax / 127.0, 1.0)
+        q = jnp.clip(jnp.rint(k / s[..., None, None]),
+                     -127, 127).astype(jnp.int8)
+        return q, s
+
+    rep = _run(int8_write, jnp.ones((2, 4, 3, 8), jnp.bfloat16))
+    assert rep.ok, rep.format()
+    assert not [f for f in rep.findings if f.op.startswith("fp8-")]
+
+
+@pytest.mark.parametrize("family",
+                         [pytest.param(f, id=f, marks=_marks_for(f))
+                          for f in ["mlp", "resnet", "gpt", "bert"]])
+def test_family_o4_train_lane_precision_clean(family):
+    """The real fp8 regime — every family's full O4 train step (qdq
+    operand quantization, e5m2 cotangent rounding, history roll in the
+    donated state) — lints clean, with f8-quantize evidence counted."""
+    import graph_lint
+    rep = graph_lint.lint_family(family, passes=("precision",),
+                                 compile=False, opt_level="O4")
+    assert rep.ok, rep.format()
+    summary = [f for f in rep.findings if f.op == "precision-summary"]
+    assert summary
+    import re
+    m = re.search(r"(\d+) f8 quantize", summary[0].message)
+    assert m and int(m.group(1)) > 0, summary[0].message
+
+
+def test_decode_kv8_lane_precision_clean():
+    """The int8-KV decode lane (quantize-on-write, fused dequant) under
+    the precision pass — the static half of the kv8 bench config."""
+    import graph_lint
+    rep = graph_lint.lint_decode("decode_b1_kv8", passes=("precision",),
+                                 compile=False)
+    assert rep.ok, rep.format()
+
+
+def test_committed_preclint_r02_covers_quant_lanes():
+    """The regenerated round-2 artifact records the fp8 regime: every
+    family's O4 lane clean WITH f8-quantize evidence, plus the int8-KV
+    decode lane."""
+    import json as _json
+    path = REPO / "PRECLINT_r02.json"
+    assert validate_preclint_file(str(path)) == []
+    doc = _json.loads(path.read_text())
+    for fam in ("mlp", "resnet", "gpt", "bert"):
+        lane = doc["lanes"][f"{fam}_o4_train"]
+        assert lane["ok"]
+        assert lane["checked"].get("fp8_quantizes", 0) > 0
+    assert doc["lanes"]["decode_b1_kv8"]["ok"]
+
+
+def test_amax_unrecorded_not_masked_by_softmax_max():
+    """Every transformer has a numerical-stability max-reduce flowing
+    into the loss; the reachability check seeds from ABS-fed reduces
+    only, so a dropped history-roll still fires through a softmax."""
+    from apex_tpu.quant import fp8 as fp8_lib
+
+    def bad(x, scale):
+        q = fp8_lib.quantize(x, scale)
+        logits = (q.astype(jnp.float32) / scale)
+        return jax.nn.softmax(logits).sum()   # softmax max reaches out
+
+    rep = _run(bad, jnp.ones((8, 8)), jnp.float32(2.0),
+               policy=amp.resolve("O4"))
+    assert "fp8-amax-unrecorded" in _fp8_errs(rep)

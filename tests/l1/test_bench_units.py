@@ -581,6 +581,36 @@ def test_bench_generate_reports_roofline_bound():
     assert r["hbm_tok_s_ceiling"] > 0 and 0 <= r["hbm_frac"]
 
 
+def test_bench_generate_kv8_byte_model_derives_higher_ceiling():
+    """The int8-KV config's ceiling comes from the int8 byte model
+    through the SAME roofline_expectation call — never hand-written:
+    at equal shapes the kv8 ceiling strictly exceeds the dense one
+    (cache term halves, plus 4 bytes/position/layer of scales), and
+    the record carries the byte-model evidence."""
+    dense = bench.bench_generate(batch=2, prefill=16, new_tokens=8,
+                                 warmup=0, iters=1, peak=None, tiny=True)
+    kv8 = bench.bench_generate(batch=2, prefill=16, new_tokens=8,
+                               warmup=0, iters=1, peak=None, tiny=True,
+                               kv_dtype="int8")
+    assert kv8["kv_dtype"] == "int8"
+    assert kv8["hbm_tok_s_ceiling"] > dense["hbm_tok_s_ceiling"]
+    # byte model: 1 byte/elem per cache + 4-byte scales per position
+    from apex_tpu.models.gpt import gpt_tiny
+    cfg = gpt_tiny()
+    m = 16 + 8
+    want = (2 * cfg.num_layers * 2 * m * cfg.hidden_size * 1
+            + 2 * cfg.num_layers * 2 * m * 4)
+    assert kv8["cache_bytes_per_step"] == want
+    assert kv8["bound"] == "bandwidth"
+
+
+def test_decode_floors_carry_kv8_config():
+    """The committed kv8 floor exists (CPU-smoke-seeded,
+    catastrophic-regression guard; on-chip ratchet is the next driver
+    round's job) and sits under the roofline like every floor."""
+    assert 0 < bench.DECODE_FLOORS["gpt_small_tpu_decode_kv8"] <= 1.0
+
+
 def test_bench_serve_tiny_cpu():
     """The serve bench path end-to-end on CPU: offered-load sweep
     c1 -> c_slots, decode-step p50/p99, the latency-tail ab gate, and

@@ -116,11 +116,11 @@ def test_prefill_accepts_concrete_zero_start(setup):
         return kc, jnp.zeros_like(kc)
 
     kc, vc = caches()
-    want, _, _ = _forward_cached(top, stacked, cfg, prompt, kc, vc,
-                                 start=0)
+    want, _, _, _, _ = _forward_cached(top, stacked, cfg, prompt,
+                                       kc, vc, start=0)
     kc, vc = caches()
-    got, _, _ = _forward_cached(top, stacked, cfg, prompt, kc, vc,
-                                start=jnp.int32(0))
+    got, _, _, _, _ = _forward_cached(top, stacked, cfg, prompt,
+                                      kc, vc, start=jnp.int32(0))
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5)
 
@@ -154,14 +154,14 @@ def test_chunked_prefill_matches_full_prefill_and_decode(setup):
     _, top, stacked, _, caches = _prefill_fixture(setup, m)
 
     kc, vc = caches()
-    want, kc_w, vc_w = _forward_cached(top, stacked, cfg, prompt,
-                                       kc, vc, start=0)
+    want, kc_w, vc_w, _, _ = _forward_cached(top, stacked, cfg, prompt,
+                                             kc, vc, start=0)
     kc, vc = caches()
     got = None
     for j in range(0, L_PROMPT, 4):
-        got, kc, vc = _forward_cached(top, stacked, cfg,
-                                      prompt[:, j:j + 4], kc, vc,
-                                      start=jnp.int32(j))
+        got, kc, vc, _, _ = _forward_cached(top, stacked, cfg,
+                                            prompt[:, j:j + 4], kc, vc,
+                                            start=jnp.int32(j))
     # cache contents are pure data movement + the same per-position
     # math: bitwise equal.  Logits of the last chunk row go through a
     # different attention SHAPE (4-row einsum vs full flash prefill),

@@ -43,7 +43,12 @@ This check fails (exit 1) when
   decode-profile schema (``apex_tpu/analysis/decode_profile.py``:
   capture provenance, the DECODE_DECOMPOSE bucket vocabulary, a
   stated verdict) — the measured half of the decode decomposition
-  stays machine-checked like the static half.
+  stays machine-checked like the static half, or
+- a committed ``CONVERGENCE_r*.json`` does not validate against the
+  convergence schema (``apex_tpu/analysis/convergence.py``: platform,
+  ``all_ok`` consistent with every lane's ``ok`` — legacy
+  single-record round-2 shape accepted) — the loss-curve /
+  decode-fidelity evidence is gate memory like everything else.
 
 It is wired into tier-1 (``tests/l0/test_gate_hygiene.py``), so a round
 cannot go green with dirty gate memory.  Best-effort on the VCS side:
@@ -76,7 +81,8 @@ PATTERNS = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json",
             "BENCH_VARIANCE.json", "KERNELBENCH_r*.json",
             "BENCH_r*.json", "INCIDENT_r*.json", "MEMLINT_r*.json",
             "PRECLINT_r*.json", "DECODE_DECOMPOSE_r*.json",
-            "OBS_r*.json", "DECODE_PROFILE_r*.json")
+            "OBS_r*.json", "DECODE_PROFILE_r*.json",
+            "CONVERGENCE_r*.json")
 
 #: Round-numbered incident artifacts additionally get schema-validated.
 INCIDENT_PATTERN = "INCIDENT_r*.json"
@@ -93,8 +99,11 @@ DECOMPOSE_PATTERN = "DECODE_DECOMPOSE_r*.json"
 #: ... and the observability artifacts ...
 OBS_PATTERN = "OBS_r*.json"
 
-#: ... and the measured decode-profile artifacts.
+#: ... and the measured decode-profile artifacts ...
 PROFILE_PATTERN = "DECODE_PROFILE_r*.json"
+
+#: ... and the convergence-evidence artifacts.
+CONVERGENCE_PATTERN = "CONVERGENCE_r*.json"
 
 
 def _load_by_path(repo: str, *rel: str):
@@ -196,6 +205,20 @@ def _validate_profiles(repo: str) -> "list[str]":
     return problems
 
 
+def _validate_convergences(repo: str) -> "list[str]":
+    """Schema problems over every present CONVERGENCE_r*.json, as
+    ``path: problem`` strings (``apex_tpu/analysis/convergence.py``)."""
+    schema = _load_by_path(repo, "apex_tpu", "analysis",
+                           "convergence.py")
+    if schema is None:
+        return []
+    problems = []
+    for p in sorted(Path(repo).glob(CONVERGENCE_PATTERN)):
+        for msg in schema.validate_convergence_file(str(p)):
+            problems.append(f"{p.name}: {msg}")
+    return problems
+
+
 def _git(repo: str, *args: str) -> "str | None":
     """stdout of a git command, or None when git/The repo is unavailable
     (the best-effort contract)."""
@@ -222,7 +245,7 @@ def check(repo: str = str(REPO)) -> dict:
                 "untracked": [], "dirty": [], "invalid_incidents": [],
                 "invalid_memlints": [], "invalid_preclints": [],
                 "invalid_decomposes": [], "invalid_obs": [],
-                "invalid_profiles": []}
+                "invalid_profiles": [], "invalid_convergences": []}
     tracked = set(tracked_raw.split())
     missing = [f for f in REQUIRED
                if not (Path(repo) / f).exists() or f not in tracked]
@@ -248,16 +271,18 @@ def check(repo: str = str(REPO)) -> dict:
     invalid_dec = _validate_decomposes(repo)
     invalid_obs = _validate_obs(repo)
     invalid_prof = _validate_profiles(repo)
+    invalid_conv = _validate_convergences(repo)
     return {"ok": not (missing or untracked or dirty or invalid
                        or invalid_mem or invalid_prec or invalid_dec
-                       or invalid_obs or invalid_prof),
+                       or invalid_obs or invalid_prof or invalid_conv),
             "missing": missing, "untracked": untracked, "dirty": dirty,
             "invalid_incidents": invalid,
             "invalid_memlints": invalid_mem,
             "invalid_preclints": invalid_prec,
             "invalid_decomposes": invalid_dec,
             "invalid_obs": invalid_obs,
-            "invalid_profiles": invalid_prof}
+            "invalid_profiles": invalid_prof,
+            "invalid_convergences": invalid_conv}
 
 
 def main(argv=None) -> int:
@@ -277,7 +302,9 @@ def main(argv=None) -> int:
               f"{verdict.get('invalid_decomposes', [])}; invalid obs "
               f"records {verdict.get('invalid_obs', [])}; invalid "
               f"decode-profile records "
-              f"{verdict.get('invalid_profiles', [])}",
+              f"{verdict.get('invalid_profiles', [])}; invalid "
+              f"convergence records "
+              f"{verdict.get('invalid_convergences', [])}",
               file=sys.stderr)
         return 1
     return 0

@@ -48,7 +48,9 @@ the PassContext: forced sub-f32 matmul accumulation, long 16-bit
 reductions, f32→16→f32 double rounds, non-f32 masters/moments under
 O2, and loss-scale placement (scale dominates the backward, unscale
 dominates the update).  ``--passes precision`` defaults to the full
-O0–O3 train matrix plus decode; ``--emit-json PRECLINT_rN.json``
+O0–O4 train matrix plus decode (o4 is the fp8 regime — delayed-scaling
+state, e4m3/e5m2 quantizes — carrying the three fp8 contract rules);
+``--emit-json PRECLINT_rN.json``
 writes the committed precision artifact (schema in
 ``apex_tpu/analysis/preclint.py``, validated by gate hygiene).
 
@@ -103,8 +105,11 @@ MEMLINT_PASSES = ("memory", "cost", "syncs")
 #: lane's resolved amp policy rides in the PassContext)
 ALL_PASSES = GRAPH_PASSES + MEMLINT_PASSES + ("precision", "policy")
 
-#: train lanes the CLI can run (opt levels); decode rides separately
-TRAIN_LANES = ("o0", "o1", "o2", "o3")
+#: train lanes the CLI can run (opt levels); decode rides separately.
+#: o4 = the fp8 regime (apex_tpu.quant): delayed-scaling state in the
+#: donated AmpState, e4m3/e5m2 quantizes in the lowered program — the
+#: lane the three fp8 precision rules run against.
+TRAIN_LANES = ("o0", "o1", "o2", "o3", "o4")
 
 #: single-chip train steps imply ZERO collective bytes; any regression
 #: that introduces one (an accidental psum, a sharding annotation leak)
@@ -114,9 +119,14 @@ COLLECTIVE_BUDGETS = {"mlp": {"total": 0}, "resnet": {"total": 0},
 
 FAMILIES = tuple(policy_audit.RAW_CASES)
 
-#: decode lanes: (batch, prefill, new_tokens) at the tiny config — the
-#: static analog of the bench's gpt_small_tpu_decode_b{1,8} lanes.
-DECODE_LANES = {"decode_b1": (1, 8, 8), "decode_b2": (2, 8, 8)}
+#: decode lanes: (batch, prefill, new_tokens, kv_dtype) at the tiny
+#: config — the static analog of the bench's gpt_small_tpu_decode_b{1,8}
+#: lanes; decode_b1_kv8 is the int8-KV path (quantize-on-write,
+#: dequant fused into the attention read — the kv8 bench config's
+#: program, machine-checked like the dense one).
+DECODE_LANES = {"decode_b1": (1, 8, 8, None),
+                "decode_b2": (2, 8, 8, None),
+                "decode_b1_kv8": (1, 8, 8, "int8")}
 
 #: serve lanes: (num_slots, block_size, num_blocks, max_blocks_per_slot)
 #: — the continuous-batching engine's compiled decode step
@@ -144,11 +154,12 @@ def build_train_step(family: str, raw=None, opt_level: str = "O1"):
 
 
 def build_decode_step(batch: int = 1, prefill: int = 8,
-                      new_tokens: int = 8):
+                      new_tokens: int = 8, kv_dtype=None):
     """(jitted_decode, args, kwargs, properties): the KV-cached
     generation step at a tiny config in the bf16 serving layout — the
     program ``apex_tpu.models.generate.generate`` dispatches — plus
-    the O2 serving policy it was cast under."""
+    the O2 serving policy it was cast under.  ``kv_dtype="int8"``
+    builds the int8-KV variant (per-position scales, fused dequant)."""
     from importlib import import_module
     gen = import_module("apex_tpu.models.generate")   # the module —
     # ``apex_tpu.models`` re-exports the ``generate`` FUNCTION under
@@ -166,7 +177,8 @@ def build_decode_step(batch: int = 1, prefill: int = 8,
            if not k.startswith("block_") and k != "layers"}
     args = (top, stacked, prompt, jnp.float32(0.0),
             jax.random.PRNGKey(0))
-    kwargs = dict(cfg=cfg, max_new_tokens=new_tokens, sample=False)
+    kwargs = dict(cfg=cfg, max_new_tokens=new_tokens, sample=False,
+                  kv_dtype=kv_dtype)
     return gen._generate_impl, args, kwargs, a.properties
 
 
@@ -308,8 +320,9 @@ def lint_decode(lane: str, passes=None, compile: bool = True,
         # e.g. --passes policy: nothing applies to a decode lane —
         # skip before paying the build + XLA compilation
         return analysis.Report()
-    batch, prefill, new_tokens = DECODE_LANES[lane]
-    fn, args, kwargs, props = build_decode_step(batch, prefill, new_tokens)
+    batch, prefill, new_tokens, kv_dtype = DECODE_LANES[lane]
+    fn, args, kwargs, props = build_decode_step(batch, prefill,
+                                                new_tokens, kv_dtype)
     lowered = fn.lower(*args, **kwargs)
     ctx = analysis.build_context(lowered, compile=compile, policy=props)
     options = {"collectives": {"budget": {"total": 0}}}
@@ -400,8 +413,8 @@ def emit_memlint(path: str, families, memory_budget=None,
     lanes: dict = {}
     n_errors = 0
     for family in families:
-        raw = policy_audit.RAW_CASES[family]()   # one build, two lanes
-        for opt_level in ("O1", "O2"):
+        raw = policy_audit.RAW_CASES[family]()   # one build, three lanes
+        for opt_level in ("O1", "O2", "O4"):
             rep = lint_family(family, compile=True, opt_level=opt_level,
                               memory_budget=memory_budget,
                               raw=raw, _collect=lanes)
@@ -472,16 +485,16 @@ def emit_preclint(path: str, families, verbose: bool = False) -> int:
                       file=sys.stderr)
 
     for family in families:
-        raw = policy_audit.RAW_CASES[family]()   # one build, four lanes
-        for opt_level in ("O0", "O1", "O2", "O3"):
+        raw = policy_audit.RAW_CASES[family]()   # one build, five lanes
+        for opt_level in ("O0", "O1", "O2", "O3", "O4"):
             step, args, props = build_train_step(family, raw=raw,
                                                  opt_level=opt_level)
             lowered = analysis.lower_quiet(step, *args)
             ctx = analysis.build_context(lowered, compile=False,
                                          policy=props)
             record(f"{family}_{opt_level.lower()}_train", ctx)
-    for lane, (b, p, n) in DECODE_LANES.items():
-        fn, args, kwargs, props = build_decode_step(b, p, n)
+    for lane, (b, p, n, kvd) in DECODE_LANES.items():
+        fn, args, kwargs, props = build_decode_step(b, p, n, kvd)
         lowered = fn.lower(*args, **kwargs)
         ctx = analysis.build_context(lowered, compile=False, policy=props)
         record(lane, ctx)
@@ -524,12 +537,14 @@ def main(argv=None) -> int:
     ap.add_argument("--passes", default=",".join(ALL_PASSES),
                     help=f"comma list from {ALL_PASSES}")
     ap.add_argument("--lanes", default=None,
-                    help="comma list from o0,o1,o2,o3,decode,serve "
-                         "(train opt levels + the decode lanes + the "
-                         "serve-engine step); default o1,decode,serve "
-                         "— except --passes precision, whose contract "
-                         "is the full O0–O3 matrix, where the default "
-                         "is o0,o1,o2,o3,decode,serve")
+                    help="comma list from o0,o1,o2,o3,o4,decode,serve "
+                         "(train opt levels incl. the fp8 O4 regime + "
+                         "the decode lanes [decode_b1_kv8 = int8 KV] + "
+                         "the serve-engine step); default "
+                         "o1,decode,serve — except --passes precision, "
+                         "whose contract is the full O0–O4 matrix, "
+                         "where the default is "
+                         "o0,o1,o2,o3,o4,decode,serve")
     ap.add_argument("--no-compile", action="store_true",
                     help="lower only (donation falls back to lowering-"
                          "time aliasing; sharding/collectives/memory/"
@@ -546,7 +561,7 @@ def main(argv=None) -> int:
                          "passes over O1+O2 train + decode + serve + "
                          "multichip slices + calibration audit; "
                          "PRECLINT_r*.json = the precision pass over "
-                         "every O0–O3 train lane + decode + serve "
+                         "every O0–O4 train lane + decode + serve "
                          "(lowering only)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print every finding, not just errors")
@@ -559,7 +574,7 @@ def main(argv=None) -> int:
         # the precision pass's documented contract is the full O0–O3
         # matrix; every other pass combination keeps the historical
         # o1,decode default (+ the serve-engine step)
-        opts.lanes = "o0,o1,o2,o3,decode,serve" \
+        opts.lanes = "o0,o1,o2,o3,o4,decode,serve" \
             if passes == ("precision",) else "o1,decode,serve"
     lanes = [x.strip().lower() for x in opts.lanes.split(",") if x.strip()]
     unknown = [f for f in families if f not in FAMILIES]
@@ -611,7 +626,7 @@ def main(argv=None) -> int:
                      "family; drop --families")
         if lanes_explicit:
             ap.error("--emit-json PRECLINT_r*.json always writes every "
-                     "lane (O0–O3 train + decode + serve); drop "
+                     "lane (O0–O4 train + decode + serve); drop "
                      "--lanes")
         if budget is not None:
             ap.error("--memory-budget does not apply to the precision "
@@ -645,7 +660,7 @@ def main(argv=None) -> int:
                      "schema-valid artifact with most of the HBM "
                      "story silently missing)")
         if lanes_explicit:
-            ap.error("--emit-json always writes every lane (O1+O2 "
+            ap.error("--emit-json always writes every lane (O1+O2+O4 "
                      "train, decode, serve, multichip); drop --lanes")
         if budget is None:
             # the artifact's whole point is the asserted per-device
@@ -685,7 +700,7 @@ def main(argv=None) -> int:
             print(f"--- {label} ---\n{report.format()}", file=sys.stderr)
 
     for family in families:
-        for opt_level in ("O0", "O1", "O2", "O3"):
+        for opt_level in ("O0", "O1", "O2", "O3", "O4"):
             if opt_level.lower() not in lanes:
                 continue
             run(f"{family}_{opt_level.lower()}",
