@@ -523,6 +523,7 @@ def make_train_step(
     has_aux: bool = False,
     finite_axes: Optional[Sequence[str]] = None,
     accum_steps: Optional[int] = None,
+    aot_cache: Optional[str] = None,
 ):
     """Build a jittable single-loss train step.
 
@@ -560,6 +561,17 @@ def make_train_step(
     ``delay_allreduce=True`` economics.  Every batch argument must carry
     the leading batch dim; with ``has_aux`` the aux comes back stacked
     per micro-step (leading ``(N,)`` dim).
+
+    ``aot_cache``: directory of the content-addressed AOT executable
+    cache (:mod:`apex_tpu.analysis.export`).  When set, the returned
+    step is self-jitting (state donated) and its FIRST call probes the
+    cache: a verified key hit — same program, same mesh, same resolved
+    policy, same jax — loads the serialized executable instead of
+    paying XLA compilation (the cold-start cost of every new training
+    replica today); a miss compiles, relints under the export gate,
+    and populates the cache for the next replica.  The resolved
+    provenance is exposed as ``step.aot_info``.  Without it the step
+    is the plain jittable (jit and donate it yourself).
     """
     if axis_name is None and reduce_fn is not None:
         axis_name = getattr(reduce_fn, "__self__", None) and \
@@ -687,4 +699,33 @@ def make_train_step(
             metrics["aux"] = aux
         return new_state, metrics
 
-    return step
+    if aot_cache is None:
+        return step
+    return _aot_cached_step(step, amp, aot_cache)
+
+
+def _aot_cached_step(step: Callable, amp: Amp, cache_dir: str):
+    """Wrap a train step so its first call resolves the executable
+    through the AOT cache (:func:`apex_tpu.analysis.export.probe`):
+    load on a verified key hit, compile + relint + export on a miss.
+    Later calls dispatch straight to the resolved executable — the
+    wrapper adds one dict lookup to the hot path, nothing else."""
+    import functools
+
+    jitted = jax.jit(step, donate_argnums=0)
+    box: dict = {}
+
+    @functools.wraps(step)
+    def cached_step(state, *batch):
+        if "compiled" not in box:
+            from apex_tpu.analysis import export as aot
+            compiled, info = aot.probe(
+                jitted, state, *batch, cache_dir=cache_dir,
+                policy=amp.properties, lane="train_step",
+                export_on_miss=True)
+            box["compiled"] = compiled
+            cached_step.aot_info = info
+        return box["compiled"](state, *batch)
+
+    cached_step.aot_info = None
+    return cached_step

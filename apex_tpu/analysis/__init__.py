@@ -58,6 +58,7 @@ from apex_tpu.analysis import cost         # noqa: F401  (registers)
 from apex_tpu.analysis import syncs       # noqa: F401  (registers)
 from apex_tpu.analysis import dflow        # noqa: F401  (shared walker)
 from apex_tpu.analysis import precision    # noqa: F401  (registers)
+from apex_tpu.analysis import export       # noqa: F401  (registers)
 
 from apex_tpu.analysis.collectives import collective_audit, collective_table
 
@@ -68,5 +69,5 @@ __all__ = [
     "PASSES", "DEFAULT_PASSES", "SEVERITIES",
     "collective_audit", "collective_table",
     "donation", "sharding", "collectives", "constants", "policy",
-    "memory", "cost", "syncs", "dflow", "precision",
+    "memory", "cost", "syncs", "dflow", "precision", "export",
 ]

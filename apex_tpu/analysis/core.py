@@ -34,6 +34,11 @@ pass                 catches
                      reductions, f32→16→f32 double rounds, non-f32
                      master weights/moments, loss-scale placement
                      (:mod:`apex_tpu.analysis.precision`)
+``export-compat``    lanes whose compiled executables cannot become
+                     AOT cache artifacts: host callbacks, platform-
+                     pinned custom calls, statically-bound scalars,
+                     baked weight constants
+                     (:mod:`apex_tpu.analysis.export`)
 ===================  ====================================================
 
 :func:`analyze` lowers (and by default compiles) a jittable function on

@@ -75,6 +75,17 @@ class ServeConfig:
     max_blocks_per_slot: int = 8
     prefill_chunk: int = 16
     kv_dtype: Optional[Any] = None
+    #: directory of the content-addressed AOT executable cache
+    #: (:mod:`apex_tpu.analysis.export`).  When set — explicitly, or
+    #: fleet-wide via the ``APEX_TPU_AOT_CACHE`` env var when this
+    #: field is ``None`` — engine startup PROBES the cache for the
+    #: compiled decode step: a verified key hit loads the serialized
+    #: executable instead of paying XLA compilation (the dominant
+    #: scale-out latency of a serving replica); a miss (or a corrupted
+    #: entry, skipped with a warning) compiles fresh, relints under
+    #: the export gate, and populates the cache for the next replica.
+    #: ``None`` with no env var keeps the plain jit path.
+    aot_cache: Optional[str] = None
 
     @property
     def int8_kv(self) -> bool:
@@ -242,10 +253,49 @@ class ServeEngine:
         self.trace_counts = {"decode": 0, "prefill": 0, "sample1": 0}
         self._decode_step = jax.jit(self._decode_body,
                                     donate_argnums=(2,))
+        #: what step() dispatches: the jit wrapper by default, or the
+        #: AOT-cache-resolved ``jax.stages.Compiled`` after a probe.
+        #: ``_decode_step`` itself always stays the jit — it is the
+        #: lowering surface the graph-lint serve lane and the export
+        #: tool build their lane from, probe or no probe.
+        self._decode_exec = self._decode_step
         self._prefill_chunk = jax.jit(self._prefill_body,
                                       donate_argnums=(2, 3, 4, 5))
         self._sample_one = jax.jit(self._sample1_body)
         self._outputs: Dict[str, np.ndarray] = {}
+        #: cold-start provenance when ``serve_cfg.aot_cache`` is set:
+        #: ``{"source": "cache"|"compile", "key": ..., "load_s"|
+        #: "compile_s": ...}`` (None on the plain jit path)
+        self.aot_info: Optional[Dict[str, Any]] = None
+        import os
+        from apex_tpu.analysis.export import CACHE_ENV
+        aot_cache = serve_cfg.aot_cache or os.environ.get(CACHE_ENV)
+        if aot_cache:
+            self._probe_aot_cache(aot_cache)
+
+    def _probe_aot_cache(self, cache_dir: str) -> None:
+        """Resolve the decode step AOT at startup: one lowering, one
+        content-addressed cache probe (:func:`apex_tpu.analysis.
+        export.probe`).  A verified hit replaces the lazy jit with the
+        deserialized executable — the engine serves its first token
+        without paying XLA compilation; a miss compiles here (eagerly
+        — the same compile the first ``step()`` would have paid),
+        relints, and exports so the NEXT replica hits.  Either way the
+        resolved executable's calling convention is exactly the jit's:
+        same donated carry, same shapes, bitwise-identical tokens."""
+        from apex_tpu.analysis import export as aot
+
+        s = self.sched
+        args = (self.top, self.stacked, self.carry,
+                jnp.asarray(s.last_tok), jnp.asarray(s.lengths),
+                jnp.asarray(s.active), jnp.asarray(s.page_table),
+                jnp.asarray(s.temperature), jnp.asarray(s.top_k),
+                jnp.asarray(s.top_p))
+        compiled, info = aot.probe(
+            self._decode_step, *args, cache_dir=cache_dir,
+            lane="serve_step", export_on_miss=True)
+        self._decode_exec = compiled
+        self.aot_info = info
 
     # -- compiled bodies ----------------------------------------------
 
@@ -441,7 +491,7 @@ class ServeEngine:
             return {}
         n_act = int(sched.active.sum())
         t0 = time.perf_counter()
-        self.carry, toks = self._decode_step(
+        self.carry, toks = self._decode_exec(
             self.top, self.stacked, self.carry,
             jnp.asarray(sched.last_tok), jnp.asarray(sched.lengths),
             jnp.asarray(sched.active), jnp.asarray(sched.page_table),

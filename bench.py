@@ -1033,6 +1033,54 @@ def check_kernel_floor_artifact(search_dir: str) -> "dict | None":
                 "error": f"artifact unreadable: {e}"[:300]}
 
 
+def find_export_artifact(search_dir: str) -> "str | None":
+    """Newest committed ``EXPORT_r{N}.json`` next to this script — the
+    AOT-export pipeline's round evidence (tools/aot_export.py writes
+    it; tools/gate_hygiene.py keeps it committed and schema-valid)."""
+    rounds = []
+    for path in glob.glob(os.path.join(search_dir, "EXPORT_r*.json")):
+        m = re.search(r"EXPORT_r(\d+)\.json$", path)
+        if m:
+            rounds.append((int(m.group(1)), path))
+    return max(rounds)[1] if rounds else None
+
+
+def check_export_cold_start(search_dir: str) -> "dict | None":
+    """Serve cold-start gate, SOURCED from the newest committed
+    EXPORT_r*.json (never re-measured here, so bench and the artifact
+    can never disagree on the number): loading the serve lane's
+    executable from the content-addressed AOT cache must cost at most
+    ``budget`` (0.5) of compiling it on the recording host — the whole
+    point of lint-then-serialize is that a scale-out replica stops
+    paying XLA compilation; a cache slower than half a compile is
+    decoration.  An ABSOLUTE gate like the MFU floors: no baseline
+    needed, fails the run via :func:`gate_exit_code`.  No artifact →
+    ``None`` (nothing to gate); unreadable → recorded but never
+    failing after the chip time is spent (the best-effort artifact
+    contract), while the verdict itself re-derives ``ok`` from the
+    numbers rather than trusting the recorded flag."""
+    path = find_export_artifact(search_dir)
+    if path is None:
+        return None
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        cs = doc.get("cold_start") if isinstance(doc, dict) else None
+        if not isinstance(cs, dict):
+            raise ValueError("no cold_start block")
+        ratio = cs["load_ratio"]
+        budget = cs["budget"]
+        return {"artifact": name, "lane": cs.get("lane"),
+                "compile_s": cs.get("compile_s"),
+                "load_s": cs.get("load_s"),
+                "load_ratio": ratio, "budget": budget,
+                "ok": bool(ratio <= budget)}
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        return {"artifact": name, "ok": True,
+                "error": f"artifact unreadable: {e}"[:300]}
+
+
 def check_floor_calibration(search_dir: str) -> dict:
     """The static half of gate calibration (apex_tpu.analysis.cost):
     the published floors (MFU_FLOORS here, KERNEL_FLOORS in
@@ -1172,9 +1220,11 @@ def gate_exit_code(regression_check: dict, compare_given: bool) -> int:
     dec = regression_check.get("decode_floors") or {}
     kfl = regression_check.get("kernel_floors") or {}
     cal = regression_check.get("floor_calibration") or {}
+    exp = regression_check.get("export_cold_start") or {}
     absolute_failed = bool(regression_check.get("ab_failures")) or \
         not mfu.get("ok", True) or not dec.get("ok", True) or \
-        not kfl.get("ok", True) or not cal.get("ok", True)
+        not kfl.get("ok", True) or not cal.get("ok", True) or \
+        not exp.get("ok", True)
     if absolute_failed or (compare_given
                            and not regression_check.get("ok", True)):
         return 2
@@ -1365,17 +1415,33 @@ def main(argv=None):
     # apex_tpu.analysis.cost — a roofline fraction or MFU floor above 1,
     # or a committed measurement above physics, is a calibration bug)
     calibration_check = check_floor_calibration(here)
+    # the serve cold-start gate rides the committed EXPORT artifact
+    # (load <= 0.5x compile; platform-independent — the artifact
+    # carries its own recording host), and the configs map records the
+    # same numbers so the cold-start story shows up next to the
+    # throughput it buys
+    export_check = check_export_cold_start(here)
+    if export_check is not None and "error" not in export_check:
+        configs["serve_cold_start"] = {
+            "source": export_check["artifact"],
+            "lane": export_check["lane"],
+            "compile_s": export_check["compile_s"],
+            "load_s": export_check["load_s"],
+            "load_ratio": export_check["load_ratio"],
+            "budget": export_check["budget"]}
     regression_check["mfu_floors"] = mfu_check
     regression_check["decode_floors"] = decode_check
     regression_check["kernel_floors"] = kernel_floor_check
     regression_check["floor_calibration"] = calibration_check
+    regression_check["export_cold_start"] = export_check
     regression_check["ab_failures"] = ab_failures
     regression_check["ok"] = bool(
         regression_check["ok"] and not ab_failures
         and (mfu_check is None or mfu_check["ok"])
         and (decode_check is None or decode_check["ok"])
         and (kernel_floor_check is None or kernel_floor_check["ok"])
-        and calibration_check["ok"])
+        and calibration_check["ok"]
+        and (export_check is None or export_check["ok"]))
     if on_tpu and regression_check["ok"]:
         # a gate-failing run must not become the future like-for-like
         # baseline (a regressed rung would mask the loss once batches
@@ -1409,7 +1475,8 @@ def main(argv=None):
               f"violations {(decode_check or {}).get('violations', [])}, "
               f"kernel-floor violations "
               f"{(kernel_floor_check or {}).get('violations', [])}, "
-              f"A/B sign failures {ab_failures} "
+              f"A/B sign failures {ab_failures}, cold-start gate "
+              f"{'FAILED' if export_check and not export_check['ok'] else 'ok'} "
               f"(deltas {regression_check.get('deltas', {})})",
               file=sys.stderr)
     return rc

@@ -17,6 +17,26 @@ import chaos_run  # noqa: E402
 from apex_tpu.resilience import validate_incident  # noqa: E402
 
 
+def test_chaos_smoke_nan_storm_fast(tmp_path):
+    """Tier-1 fast smoke (~3s): a nan-grad storm alone, fewer steps —
+    the injector/rewind/incident path stays continuously enforced
+    while the two-fault 27s run above rides ``-m slow`` (ROADMAP
+    item 6's last named tier-1 heavy)."""
+    out = tmp_path / "INCIDENT_fast_smoke.json"
+    rc = chaos_run.main([
+        "--steps", "8",
+        "--faults", "nan_storm@3",
+        "--checkpoint-every", "2",
+        "--ckpt-dir", str(tmp_path / "ckpt"),
+        "--out", str(out),
+    ])
+    assert rc == 0
+    rec = json.loads(out.read_text())
+    assert validate_incident(rec) == []
+    assert rec["status"] == "recovered"
+    assert "nan_storm" in json.dumps(rec)
+
+
 @pytest.mark.slow
 def test_chaos_smoke_nan_storm_plus_truncation(tmp_path):
     out = tmp_path / "INCIDENT_chaos_smoke.json"

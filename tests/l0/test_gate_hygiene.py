@@ -412,6 +412,69 @@ def test_convergence_all_ok_must_match_lanes(tmp_repo):
     assert gate_hygiene.check(str(tmp_repo))["ok"]
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 10: EXPORT_r*.json is gate memory too
+# ---------------------------------------------------------------------------
+
+def _valid_export():
+    return {"round": 1, "platform": "cpu",
+            "versions": {"jax": "0.4.37"},
+            "lanes": {
+                "mlp_o1_train": {
+                    "export_ok": True, "cache_key": "a" * 64,
+                    "module_sha256": "b" * 64,
+                    "lint": {"ok": True, "counts": {}},
+                    "compile_s": 0.3, "load_s": 0.01,
+                    "bitwise_equal": True}},
+            "cold_start": {"lane": "mlp_o1_train", "compile_s": 0.3,
+                           "load_s": 0.01, "load_ratio": 0.03,
+                           "budget": 0.5, "ok": True}}
+
+
+def test_committed_export_validated_against_schema(tmp_repo):
+    _analysis_module(tmp_repo, "export_schema")
+    (tmp_repo / "EXPORT_r07_bad.json").write_text('{"round": 7}')
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "bad export")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("EXPORT_r07_bad.json" in p
+               for p in verdict["invalid_exports"])
+    assert gate_hygiene.main(["--repo", str(tmp_repo)]) == 1
+
+
+def test_export_contradictory_verdict_fails_hygiene(tmp_repo):
+    """The an-executable-only-enters-clean invariant is schema-level:
+    a committed record claiming export_ok over a FAILING lint report
+    fails hygiene."""
+    _analysis_module(tmp_repo, "export_schema")
+    doc = _valid_export()
+    doc["lanes"]["mlp_o1_train"]["lint"]["ok"] = False
+    (tmp_repo / "EXPORT_r08_lie.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "contradictory export")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert any("contradictory" in p for p in verdict["invalid_exports"])
+
+
+def test_valid_export_passes_and_untracked_fails(tmp_repo):
+    _analysis_module(tmp_repo, "export_schema")
+    (tmp_repo / "EXPORT_r09_ok.json").write_text(
+        json.dumps(_valid_export()))
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert verdict["untracked"] == ["EXPORT_r09_ok.json"]
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "good export")
+    assert gate_hygiene.check(str(tmp_repo))["ok"]
+
+
+def test_repo_export_validates():
+    """The committed EXPORT artifact is the schema's reference
+    instance; it must stay valid."""
+    assert gate_hygiene._validate_exports(str(REPO)) == []
+
+
 def test_real_committed_convergence_artifacts_validate():
     """Every CONVERGENCE_r*.json in the real repo — the legacy r02
     shape through the r06 quant lanes — validates."""

@@ -640,3 +640,23 @@ def test_decode_lanes_table_carries_kv8():
     import graph_lint
     assert graph_lint.DECODE_LANES["decode_b1_kv8"][3] == "int8"
     assert "o4" in graph_lint.TRAIN_LANES
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10: the export-compat pass rides the lint CLI too
+# ---------------------------------------------------------------------------
+
+def test_cli_export_compat_pass_clean(capsys):
+    """``--passes export-compat`` over the train + serve lanes: the
+    lanes the AOT export pipeline serializes lint serializable
+    (lowering-only — the pass reads StableHLO text, so the CLI skips
+    the per-lane compile exactly like the precision-only mode)."""
+    import graph_lint
+    assert graph_lint.main(["--families", "mlp",
+                            "--passes", "export-compat",
+                            "--lanes", "o1,serve"]) == 0
+    out = capsys.readouterr().out
+    assert '"lane": "mlp_o1"' in out and '"lane": "serve_step"' in out
+    for line in out.splitlines():
+        rec = json.loads(line)
+        assert rec["ok"] and rec["passes"] == ["export-compat"]

@@ -48,7 +48,15 @@ This check fails (exit 1) when
   convergence schema (``apex_tpu/analysis/convergence.py``: platform,
   ``all_ok`` consistent with every lane's ``ok`` — legacy
   single-record round-2 shape accepted) — the loss-curve /
-  decode-fidelity evidence is gate memory like everything else.
+  decode-fidelity evidence is gate memory like everything else, or
+- a committed ``EXPORT_r*.json`` does not validate against the
+  AOT-export schema (``apex_tpu/analysis/export_schema.py``: per-lane
+  cache keys, gating lint verdicts consistent with ``export_ok`` —
+  an exported lane with a failing lint report, or without a passing
+  bitwise round trip, is a CONTRADICTORY verdict and schema-invalid —
+  refused lanes naming the documented finding id, and a ``cold_start``
+  block whose ``ok`` agrees with its own load-vs-compile numbers) —
+  the executable cache's build evidence is gate memory too.
 
 It is wired into tier-1 (``tests/l0/test_gate_hygiene.py``), so a round
 cannot go green with dirty gate memory.  Best-effort on the VCS side:
@@ -82,7 +90,7 @@ PATTERNS = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json",
             "BENCH_r*.json", "INCIDENT_r*.json", "MEMLINT_r*.json",
             "PRECLINT_r*.json", "DECODE_DECOMPOSE_r*.json",
             "OBS_r*.json", "DECODE_PROFILE_r*.json",
-            "CONVERGENCE_r*.json")
+            "CONVERGENCE_r*.json", "EXPORT_r*.json")
 
 #: Round-numbered incident artifacts additionally get schema-validated.
 INCIDENT_PATTERN = "INCIDENT_r*.json"
@@ -102,8 +110,11 @@ OBS_PATTERN = "OBS_r*.json"
 #: ... and the measured decode-profile artifacts ...
 PROFILE_PATTERN = "DECODE_PROFILE_r*.json"
 
-#: ... and the convergence-evidence artifacts.
+#: ... and the convergence-evidence artifacts ...
 CONVERGENCE_PATTERN = "CONVERGENCE_r*.json"
+
+#: ... and the AOT-export artifacts.
+EXPORT_PATTERN = "EXPORT_r*.json"
 
 
 def _load_by_path(repo: str, *rel: str):
@@ -219,6 +230,21 @@ def _validate_convergences(repo: str) -> "list[str]":
     return problems
 
 
+def _validate_exports(repo: str) -> "list[str]":
+    """Schema problems over every present EXPORT_r*.json, as
+    ``path: problem`` strings
+    (``apex_tpu/analysis/export_schema.py``)."""
+    schema = _load_by_path(repo, "apex_tpu", "analysis",
+                           "export_schema.py")
+    if schema is None:
+        return []
+    problems = []
+    for p in sorted(Path(repo).glob(EXPORT_PATTERN)):
+        for msg in schema.validate_export_file(str(p)):
+            problems.append(f"{p.name}: {msg}")
+    return problems
+
+
 def _git(repo: str, *args: str) -> "str | None":
     """stdout of a git command, or None when git/The repo is unavailable
     (the best-effort contract)."""
@@ -245,7 +271,8 @@ def check(repo: str = str(REPO)) -> dict:
                 "untracked": [], "dirty": [], "invalid_incidents": [],
                 "invalid_memlints": [], "invalid_preclints": [],
                 "invalid_decomposes": [], "invalid_obs": [],
-                "invalid_profiles": [], "invalid_convergences": []}
+                "invalid_profiles": [], "invalid_convergences": [],
+                "invalid_exports": []}
     tracked = set(tracked_raw.split())
     missing = [f for f in REQUIRED
                if not (Path(repo) / f).exists() or f not in tracked]
@@ -272,9 +299,11 @@ def check(repo: str = str(REPO)) -> dict:
     invalid_obs = _validate_obs(repo)
     invalid_prof = _validate_profiles(repo)
     invalid_conv = _validate_convergences(repo)
+    invalid_exp = _validate_exports(repo)
     return {"ok": not (missing or untracked or dirty or invalid
                        or invalid_mem or invalid_prec or invalid_dec
-                       or invalid_obs or invalid_prof or invalid_conv),
+                       or invalid_obs or invalid_prof or invalid_conv
+                       or invalid_exp),
             "missing": missing, "untracked": untracked, "dirty": dirty,
             "invalid_incidents": invalid,
             "invalid_memlints": invalid_mem,
@@ -282,7 +311,8 @@ def check(repo: str = str(REPO)) -> dict:
             "invalid_decomposes": invalid_dec,
             "invalid_obs": invalid_obs,
             "invalid_profiles": invalid_prof,
-            "invalid_convergences": invalid_conv}
+            "invalid_convergences": invalid_conv,
+            "invalid_exports": invalid_exp}
 
 
 def main(argv=None) -> int:
@@ -304,7 +334,8 @@ def main(argv=None) -> int:
               f"decode-profile records "
               f"{verdict.get('invalid_profiles', [])}; invalid "
               f"convergence records "
-              f"{verdict.get('invalid_convergences', [])}",
+              f"{verdict.get('invalid_convergences', [])}; invalid "
+              f"export records {verdict.get('invalid_exports', [])}",
               file=sys.stderr)
         return 1
     return 0

@@ -54,6 +54,13 @@ state, e4m3/e5m2 quantizes — carrying the three fp8 contract rules);
 writes the committed precision artifact (schema in
 ``apex_tpu/analysis/preclint.py``, validated by gate hygiene).
 
+The **export-compat pass** (``apex_tpu/analysis/export.py``) is
+registered too — ``--passes export-compat`` lints any lane's
+AOT-serializability (host callbacks, platform-pinned custom calls,
+static captures, baked constants); ``tools/aot_export.py`` runs it as
+part of the export gate that builds the content-addressed executable
+cache from these same lanes.
+
 Usage:
     python tools/graph_lint.py [--families mlp,gpt] [--passes donation,...]
                                [--lanes o0,o1,o2,o3,decode,serve]
@@ -601,7 +608,7 @@ def main(argv=None) -> int:
     # PRECLINT artifact path does — but an armed memory budget with no
     # memory pass requested must be refused, not silently unasserted
     lowering_only = set(passes) <= {"precision", "policy",
-                                    "constant-capture"}
+                                    "constant-capture", "export-compat"}
     if lowering_only and budget is not None:
         ap.error("--memory-budget needs the memory pass; the requested "
                  f"--passes {','.join(passes)} never reads it (an "
