@@ -1,0 +1,210 @@
+"""SERVE_DISAGG_r*.json — schema for the committed disaggregated-
+serving gate artifact.
+
+``tools/serve_disagg.py`` writes one of these per round: the
+disaggregated-vs-monolithic offered-load A/B (one prefill mesh slice +
+N decode replicas on disjoint slices behind the KV-shipping router,
+versus one monolithic engine with the same total slots, fed the SAME
+request stream) plus the replica-kill chaos drill.  The headline gate
+is the DistServe/Splitwise claim in machine-checked form: at equal
+device count, disaggregated decode p99 must not exceed the monolithic
+engine's — and a recorded verdict that contradicts its own numbers is
+SCHEMA-INVALID, so the artifact can never say "ok" over a lost A/B.
+
+Like the other gate artifacts, this is gate memory:
+``tools/gate_hygiene.py`` validates every committed
+``SERVE_DISAGG_r*.json`` against this module in tier-1.
+
+This module is deliberately **stdlib-only** (no jax import):
+``gate_hygiene`` loads it directly by file path the same way it loads
+the other ``apex_tpu/analysis`` schema modules.
+
+Document shape::
+
+    {
+      "round": 1,
+      "platform": "cpu",
+      "config": {"model": "gpt_tiny", "concurrency": 16,
+                 "prefill": 64, "new_tokens": 16, "block_size": 4},
+      "topology": {                       # device slices, DISJOINT
+        "n_devices": 16, "transfer": "ship",
+        "prefill_devices": [0],
+        "replica_devices": [[1], [2]]
+      },
+      "mono":   {"num_slots": 16, "tok_s": ..., "p50_ms": ...,
+                 "p99_ms": ..., "steps": ..., "retraces": 1},
+      "disagg": {"slots_per_replica": 8, "n_replicas": 2,
+                 "tok_s": ..., "p50_ms": ..., "p99_ms": ...,
+                 "per_replica": [{"steps": ..., "p50_ms": ...,
+                                  "p99_ms": ...}, ...],
+                 "kv_transfer_bytes": ..., "shipments": ...,
+                 "reroutes": 0},
+      "chaos":  {                         # the replica-kill drill
+        "killed_replica": 0, "rerouted": 2, "bitwise_ok": true
+      },
+      "gate": {"p99_ok": true, "ok": true},
+      "note": "..."
+    }
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+#: the KV paths the router can run
+TRANSFER_MODES = ("ship", "recompute")
+
+
+def _num(x) -> bool:
+    return isinstance(x, (int, float)) and not isinstance(x, bool)
+
+
+def validate_serve_disagg(doc) -> List[str]:
+    """Problems with one parsed SERVE_DISAGG document (empty =
+    valid)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not a JSON object"]
+    if not isinstance(doc.get("round"), int):
+        problems.append("missing/invalid 'round' (int)")
+    if not isinstance(doc.get("platform"), str):
+        problems.append("missing/invalid 'platform' (str)")
+    cfg = doc.get("config")
+    if not isinstance(cfg, dict) or not all(
+            isinstance(cfg.get(k), int)
+            for k in ("concurrency", "prefill", "new_tokens")):
+        problems.append("missing/invalid 'config' "
+                        "(concurrency/prefill/new_tokens ints)")
+
+    # -- topology: the slices must actually be disjoint ---------------
+    topo = doc.get("topology")
+    if not isinstance(topo, dict):
+        problems.append("missing/invalid 'topology' object")
+    else:
+        if not isinstance(topo.get("n_devices"), int) \
+                or topo["n_devices"] < 2:
+            problems.append("topology.n_devices missing or < 2 "
+                            "(a fleet needs a prefill AND a decode "
+                            "slice)")
+        if topo.get("transfer") not in TRANSFER_MODES:
+            problems.append(
+                f"topology.transfer {topo.get('transfer')!r} not in "
+                f"{TRANSFER_MODES}")
+        pre = topo.get("prefill_devices")
+        reps = topo.get("replica_devices")
+        if not (isinstance(pre, list) and pre
+                and all(isinstance(d, int) for d in pre)):
+            problems.append("topology.prefill_devices must be a "
+                            "non-empty int list")
+            pre = None
+        if not (isinstance(reps, list) and reps
+                and all(isinstance(r, list) and r
+                        and all(isinstance(d, int) for d in r)
+                        for r in reps)):
+            problems.append("topology.replica_devices must be a "
+                            "non-empty list of non-empty int lists")
+            reps = None
+        if pre is not None and reps is not None:
+            slices = [pre] + list(reps)
+            flat = [d for s in slices for d in s]
+            if len(flat) != len(set(flat)):
+                problems.append(
+                    "topology slices OVERLAP — shared devices fake "
+                    "the disaggregation (prefill bursts would steal "
+                    "decode cycles)")
+            if isinstance(topo.get("n_devices"), int) \
+                    and len(flat) > topo["n_devices"]:
+                problems.append(
+                    f"topology claims {len(flat)} sliced devices on "
+                    f"an n_devices={topo['n_devices']} platform")
+
+    # -- the two arms -------------------------------------------------
+    def check_arm(name):
+        arm = doc.get(name)
+        if not isinstance(arm, dict):
+            problems.append(f"missing/invalid '{name}' object")
+            return None
+        for k in ("tok_s", "p50_ms", "p99_ms"):
+            if not _num(arm.get(k)) or arm[k] < 0:
+                problems.append(f"{name}.{k} missing or not a "
+                                f"non-negative number: {arm.get(k)!r}")
+                return None
+        if arm["p99_ms"] < arm["p50_ms"]:
+            problems.append(f"{name}: p99 {arm['p99_ms']} under p50 "
+                            f"{arm['p50_ms']} — not a percentile pair")
+        return arm
+
+    mono = check_arm("mono")
+    disagg = check_arm("disagg")
+    if disagg is not None:
+        for k in ("kv_transfer_bytes", "shipments", "reroutes"):
+            if not _num(disagg.get(k)) or disagg[k] < 0:
+                problems.append(f"disagg.{k} missing or not a "
+                                f"non-negative number: "
+                                f"{disagg.get(k)!r}")
+        pr = disagg.get("per_replica")
+        if not (isinstance(pr, list) and pr
+                and all(isinstance(r, dict) for r in pr)):
+            problems.append("disagg.per_replica must be a non-empty "
+                            "list of per-replica records")
+        if isinstance(topo, dict) and _num(disagg.get("shipments")) \
+                and topo.get("transfer") == "ship" \
+                and disagg["shipments"] > 0 \
+                and _num(disagg.get("kv_transfer_bytes")) \
+                and disagg["kv_transfer_bytes"] <= 0:
+            problems.append(
+                "disagg records shipments under transfer='ship' but "
+                "zero kv_transfer_bytes — shipped KV moves bytes")
+
+    # -- chaos drill --------------------------------------------------
+    chaos = doc.get("chaos")
+    if chaos is not None:
+        if not isinstance(chaos, dict):
+            problems.append("'chaos' present but not an object")
+            chaos = None
+        else:
+            if not isinstance(chaos.get("killed_replica"), int):
+                problems.append("chaos.killed_replica missing (int)")
+            if not isinstance(chaos.get("rerouted"), int) \
+                    or chaos["rerouted"] < 1:
+                problems.append(
+                    "chaos.rerouted missing or < 1 — a kill that "
+                    "rerouted nothing drilled nothing")
+            if not isinstance(chaos.get("bitwise_ok"), bool):
+                problems.append("chaos.bitwise_ok missing (bool)")
+
+    # -- the gate: verdicts must agree with their own numbers ---------
+    gate = doc.get("gate")
+    if not isinstance(gate, dict) \
+            or not isinstance(gate.get("p99_ok"), bool) \
+            or not isinstance(gate.get("ok"), bool):
+        problems.append("missing/invalid 'gate' (p99_ok + ok bools)")
+    else:
+        if mono is not None and disagg is not None:
+            derived = disagg["p99_ms"] <= mono["p99_ms"]
+            if gate["p99_ok"] != derived:
+                problems.append(
+                    f"CONTRADICTORY verdict: gate.p99_ok="
+                    f"{gate['p99_ok']} but disagg p99 "
+                    f"{disagg['p99_ms']} vs mono p99 {mono['p99_ms']} "
+                    f"derives {derived}")
+        chaos_ok = True if chaos is None \
+            else chaos.get("bitwise_ok") is True
+        if gate["ok"] != (gate["p99_ok"] and chaos_ok):
+            problems.append(
+                f"CONTRADICTORY verdict: gate.ok={gate['ok']} but "
+                f"p99_ok={gate['p99_ok']} and chaos "
+                f"{'absent' if chaos is None else chaos.get('bitwise_ok')} "
+                f"derive {gate['p99_ok'] and chaos_ok}")
+    return problems
+
+
+def validate_serve_disagg_file(path: str) -> List[str]:
+    """Problems with one SERVE_DISAGG_r*.json file (empty = valid)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable serve-disagg JSON: {e}"]
+    return validate_serve_disagg(doc)

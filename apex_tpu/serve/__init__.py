@@ -4,10 +4,12 @@ Production serving over the training checkpoint: a fixed-slot
 continuous-batching scheduler (:mod:`apex_tpu.serve.scheduler`), a
 paged block-pool KV cache read through per-slot page tables
 (:mod:`apex_tpu.serve.paged`), a fused on-device sampling epilogue
-(:mod:`apex_tpu.serve.sampling`), and the engine tying them into ONE
+(:mod:`apex_tpu.serve.sampling`), the engine tying them into ONE
 compiled decode step that never retraces across admission, retirement,
-or preemption (:mod:`apex_tpu.serve.engine`).  See
-``docs/source/serving.rst``.
+or preemption (:mod:`apex_tpu.serve.engine`), and the disaggregated
+fleet layer running prefill and decode on SEPARATE mesh slices behind
+one KV-shipping router (:mod:`apex_tpu.serve.transfer`,
+:mod:`apex_tpu.serve.router`).  See ``docs/source/serving.rst``.
 """
 
 from apex_tpu.serve.engine import ServeConfig, ServeEngine
@@ -20,20 +22,41 @@ from apex_tpu.serve.paged import (
     paged_attention,
     token_write_coords,
 )
-from apex_tpu.serve.sampling import sample_tokens
+from apex_tpu.serve.router import (
+    DecodeReplica,
+    DisaggRouter,
+    PrefillWorker,
+    RouterConfig,
+)
+from apex_tpu.serve.sampling import advance_key, sample_tokens
 from apex_tpu.serve.scheduler import Request, SlotScheduler
+from apex_tpu.serve.transfer import (
+    FleetSlices,
+    KVShipment,
+    ship,
+    slice_fleet,
+)
 
 __all__ = [
     "BlockAllocator",
+    "DecodeReplica",
+    "DisaggRouter",
+    "FleetSlices",
+    "KVShipment",
     "PoolExhausted",
+    "PrefillWorker",
     "Request",
+    "RouterConfig",
     "ServeConfig",
     "ServeEngine",
     "SlotScheduler",
     "TRASH_BLOCK",
+    "advance_key",
     "gather_slot_kv",
     "make_pools",
     "paged_attention",
     "sample_tokens",
+    "ship",
+    "slice_fleet",
     "token_write_coords",
 ]

@@ -84,8 +84,11 @@ class ServeConfig:
     #: scale-out latency of a serving replica); a miss (or a corrupted
     #: entry, skipped with a warning) compiles fresh, relints under
     #: the export gate, and populates the cache for the next replica.
-    #: ``None`` with no env var keeps the plain jit path.
-    aot_cache: Optional[str] = None
+    #: ``None`` with no env var keeps the plain jit path; ``False``
+    #: disables probing outright, env var included (the disaggregated
+    #: prefill worker's engine never runs the decode step the probe
+    #: would compile).
+    aot_cache: Optional[Any] = None
 
     @property
     def int8_kv(self) -> bool:
@@ -193,9 +196,14 @@ class ServeEngine:
     """
 
     def __init__(self, params, cfg: GPTConfig, serve_cfg: ServeConfig,
-                 registry: Optional[obs_metrics.Registry] = None):
+                 registry: Optional[obs_metrics.Registry] = None,
+                 placement: Optional[Any] = None):
         self.cfg = cfg
         self.scfg = serve_cfg
+        #: committed sharding pinning this engine to one mesh slice
+        #: (the disaggregated fleet's replica isolation —
+        #: :mod:`apex_tpu.serve.transfer`); None = process default
+        self.placement = placement
         #: telemetry (apex_tpu.obs) — shared with the scheduler; every
         #: update is host-side bookkeeping at a step boundary, and the
         #: step-latency observation times a dispatch+fetch the host
@@ -247,6 +255,15 @@ class ServeEngine:
                 serve_cfg.block_size, cfg.num_heads, head_dim, kv_dtype)
             self.carry = {"kc": kc, "vc": vc, "keys": keys}
             self._m_kv_err = None
+        if placement is not None:
+            # pin the engine to its slice: COMMITTED params and carry
+            # make every dispatched program (and its donated updates)
+            # execute on these devices — jax follows the committed
+            # operands, so nothing else needs a device annotation
+            from apex_tpu.serve.transfer import place_tree
+            self.top = place_tree(self.top, placement)
+            self.stacked = place_tree(self.stacked, placement)
+            self.carry = place_tree(self.carry, placement)
         #: python-body executions of each traced function — a retrace
         #: (shape drift across admit/retire) increments these past 1;
         #: tests assert they stay there across a whole mixed stream
@@ -269,7 +286,13 @@ class ServeEngine:
         self.aot_info: Optional[Dict[str, Any]] = None
         import os
         from apex_tpu.analysis.export import CACHE_ENV
-        aot_cache = serve_cfg.aot_cache or os.environ.get(CACHE_ENV)
+        # None = fall back to the fleet-wide env var; False = probing
+        # EXPLICITLY disabled, env var included (the disaggregated
+        # prefill worker: its engine never dispatches the decode step
+        # the probe would compile+export)
+        aot_cache = serve_cfg.aot_cache
+        if aot_cache is None:
+            aot_cache = os.environ.get(CACHE_ENV)
         if aot_cache:
             self._probe_aot_cache(aot_cache)
 
@@ -291,8 +314,19 @@ class ServeEngine:
                 jnp.asarray(s.active), jnp.asarray(s.page_table),
                 jnp.asarray(s.temperature), jnp.asarray(s.top_k),
                 jnp.asarray(s.top_p))
+        # a PLACED engine (one replica of the disaggregated fleet)
+        # keys its entry per mesh slice: a PJRT executable is pinned
+        # to the devices it was compiled for, so a load across slices
+        # would be a wrong-device executable, not a faster cold start
+        # — the device ids join the mesh descriptor and each slice's
+        # replicas share (and restart from) their own entry
+        mesh = None
+        if self.placement is not None:
+            devs = sorted(d.id for d in self.placement.device_set)
+            mesh = (f"{jax.default_backend()}[{len(devs)}]"
+                    f"@{','.join(str(d) for d in devs)}")
         compiled, info = aot.probe(
-            self._decode_step, *args, cache_dir=cache_dir,
+            self._decode_step, *args, cache_dir=cache_dir, mesh=mesh,
             lane="serve_step", export_on_miss=True)
         self._decode_exec = compiled
         self.aot_info = info

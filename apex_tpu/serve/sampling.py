@@ -30,6 +30,32 @@ import jax.numpy as jnp
 from apex_tpu.models.generate import NEG_INF
 
 
+_ADVANCE = None
+
+
+def advance_key(key: jax.Array, n: int) -> jax.Array:
+    """The per-slot PRNG chain's position after ``n`` draws: each
+    :func:`sample_tokens` call advances a slot's key exactly once
+    (``nk, _ = split(key)`` — greedy slots included), so the key a
+    request's slot holds after streaming ``n`` tokens (the prefill
+    sample counts) is a pure function of ``(seed, n)``.  The router's
+    replica-kill recovery re-derives lost device keys with this:
+    ``advance_key(PRNGKey(req.seed), tokens_streamed)`` resumes the
+    exact chain the dead replica was on.  The chain rolls in ONE
+    compiled ``fori_loop`` dispatch (``n`` is a dynamic argument —
+    failure recovery for a thousand-token stream must not pay a
+    thousand eager splits)."""
+    key = jnp.asarray(key, jnp.uint32)
+    n = int(n)
+    if n == 0:
+        return key
+    global _ADVANCE
+    if _ADVANCE is None:
+        _ADVANCE = jax.jit(lambda k, m: jax.lax.fori_loop(
+            0, m, lambda _, kk: jax.random.split(kk)[0], k))
+    return _ADVANCE(key, jnp.int32(n))
+
+
 def sample_tokens(logits: jax.Array, keys: jax.Array,
                   temperature: jax.Array, top_k: jax.Array,
                   top_p: jax.Array):

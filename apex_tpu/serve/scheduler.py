@@ -66,6 +66,33 @@ class Request:
     resume_key: Optional[np.ndarray] = None
 
 
+def validate_request(req: Request, block_size: int,
+                     max_blocks_per_slot: int, num_blocks: int) -> None:
+    """Reject a request that can NEVER run on a pool of these shapes
+    (empty prompt / zero budget, context over the per-slot page-table
+    reach, footprint over the whole usable pool) — at submission, not
+    deadlocked later.  ONE implementation serves both front doors:
+    :meth:`SlotScheduler.submit` and the disaggregated router's
+    ``submit`` (every decode replica is identical, so the router
+    validates against the same shapes its replicas hold)."""
+    if len(req.prompt) < 1 or req.max_new_tokens < 1:
+        raise ValueError(
+            f"{req.uid}: need a non-empty prompt and "
+            f"max_new_tokens >= 1")
+    total = len(req.prompt) + req.max_new_tokens
+    max_context = max_blocks_per_slot * block_size
+    if total > max_context:
+        raise ValueError(
+            f"{req.uid}: prompt+max_new = {total} exceeds the "
+            f"per-slot context {max_context} "
+            f"({max_blocks_per_slot} blocks x {block_size})")
+    need = -(-total // block_size)
+    if need > num_blocks - 1:
+        raise ValueError(
+            f"{req.uid}: needs {need} blocks, pool has "
+            f"{num_blocks - 1} usable")
+
+
 @dataclasses.dataclass
 class _Slot:
     request: Request
@@ -139,24 +166,10 @@ class SlotScheduler:
         return -(-total // self.block_size)
 
     def submit(self, req: Request) -> None:
-        """Validate and enqueue.  Requests that can NEVER run (context
-        over the per-slot page-table reach, footprint over the whole
-        pool) are rejected here, not deadlocked later."""
-        if len(req.prompt) < 1 or req.max_new_tokens < 1:
-            raise ValueError(
-                f"{req.uid}: need a non-empty prompt and "
-                f"max_new_tokens >= 1")
-        total = len(req.prompt) + req.max_new_tokens
-        if total > self.max_context:
-            raise ValueError(
-                f"{req.uid}: prompt+max_new = {total} exceeds the "
-                f"per-slot context {self.max_context} "
-                f"({self.max_blocks_per_slot} blocks x "
-                f"{self.block_size})")
-        if self.blocks_needed(req) > self.allocator.num_blocks - 1:
-            raise ValueError(
-                f"{req.uid}: needs {self.blocks_needed(req)} blocks, "
-                f"pool has {self.allocator.num_blocks - 1} usable")
+        """Validate (:func:`validate_request`) and enqueue — requests
+        that can NEVER run are rejected here, not deadlocked later."""
+        validate_request(req, self.block_size, self.max_blocks_per_slot,
+                         self.allocator.num_blocks)
         self.queue.append(req)
         self._m_queue.set(float(len(self.queue)))
 
@@ -263,29 +276,42 @@ class SlotScheduler:
         toks = list(s.request.prior_tokens) + s.emitted
         return s.request.uid, np.asarray(toks, np.int32)
 
-    def preempt(self, slot: int, resume_key: np.ndarray) -> Request:
-        """Evict ``slot`` (recompute-on-resume): blocks free, and a
-        continuation request — original prompt extended with every
-        generated token, remaining budget, the live PRNG key — joins
-        the BACK of the queue.  Returns the continuation."""
+    def continuation(self, slot: int,
+                     resume_key: np.ndarray) -> Request:
+        """The recompute-on-resume continuation record for a live
+        slot: original prompt extended with every generated token,
+        remaining budget, ``prior_tokens`` carried, and the PRNG key
+        the stream resumes with.  ONE builder serves both interrupt
+        paths — :meth:`preempt` (the slot's live key, snapshotted)
+        and the router's replica-kill recovery (the key re-derived by
+        draw count) — so the continuation contract cannot drift
+        between them."""
         s = self.slots[slot]
         req = s.request
         done_tokens = list(req.prior_tokens) + s.emitted
         remaining = req.max_new_tokens - len(s.emitted)
         if remaining < 1:
             raise RuntimeError(
-                f"{req.uid}: preempting a finished slot (bug: retire "
+                f"{req.uid}: continuing a finished slot (bug: retire "
                 f"should have run first)")
-        cont = dataclasses.replace(
+        return dataclasses.replace(
             req,
             prompt=np.concatenate(
                 [np.asarray(req.prompt, np.int32),
                  np.asarray(s.emitted, np.int32)]),
             max_new_tokens=remaining,
-            prior_tokens=tuple(done_tokens),
+            prior_tokens=tuple(int(t) for t in done_tokens),
             resume_key=np.asarray(resume_key),
         )
-        self.allocator.free(s.blocks, req)
+
+    def preempt(self, slot: int, resume_key: np.ndarray) -> Request:
+        """Evict ``slot`` (recompute-on-resume): blocks free, and the
+        :meth:`continuation` — original prompt + generated tokens,
+        remaining budget, the live PRNG key — joins the BACK of the
+        queue.  Returns the continuation."""
+        cont = self.continuation(slot, resume_key)
+        s = self.slots[slot]
+        self.allocator.free(s.blocks, s.request)
         self._clear(slot)
         self.queue.append(cont)
         self._m_preempt.inc()

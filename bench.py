@@ -680,6 +680,186 @@ def bench_serve(warmup: int, iters: int, peak: float,
             "ab_ok": bool(tail_ok)}
 
 
+def _merged_decode_quantile(pairs, q: float) -> float:
+    """Fleet-level decode-step quantile: union the replicas' own
+    ``serve_decode_step_seconds`` windows (same fixed bucket ladder)
+    and interpolate through the SAME :class:`~apex_tpu.obs.metrics.
+    Histogram` math bench and a production scrape use — never a
+    private percentile implementation."""
+    from apex_tpu.obs.metrics import Histogram, Registry
+
+    merged = Histogram(Registry(), "_merged_decode_window")
+    for hist, mark in pairs:
+        merged.counts = merged.counts + (hist.counts - mark[0])
+        merged.sum += hist.sum - mark[1]
+        merged.count += hist.count - mark[2]
+        # the window's max is only known when it SET the running max —
+        # the same stale-max guard Histogram.quantile(since=) applies,
+        # or an excluded pre-mark compile step would stretch the
+        # overflow bucket of the merged window
+        if hist._max > mark[3]:
+            merged._max = max(merged._max, hist._max)
+    return merged.quantile(q)
+
+
+def bench_serve_disagg(warmup: int, iters: int, peak: float,
+                       n_replicas: int = 2, slots_per_replica: int = 8,
+                       prefill: int = 512, new_tokens: int = 128,
+                       tiny: bool = False):
+    """Disaggregated-vs-monolithic serve A/B at EQUAL resources
+    (:class:`apex_tpu.serve.DisaggRouter` vs one
+    :class:`~apex_tpu.serve.ServeEngine`): the same offered load —
+    ``c = n_replicas x slots_per_replica`` mixed-length requests, the
+    same request stream, the same platform — served (a) by one
+    monolithic engine with ``c`` slots interleaving prefill chunks and
+    decode steps on one set of devices, and (b) by the disaggregated
+    fleet: prefill on its own mesh slice, ``n_replicas`` decode
+    replicas of ``slots_per_replica`` slots each on disjoint slices,
+    KV shipped between them.
+
+    Per arm: ``tok_s`` and decode-step ``p50_ms``/``p99_ms`` read from
+    the engines' OWN ``serve_decode_step_seconds`` histograms (the
+    disagg fleet's percentiles union the replicas' windows through the
+    same Histogram math).  ``ab_ok`` is the DistServe/Splitwise claim
+    as a gate: ``disagg p99 <= mono p99`` at equal device count —
+    splitting bursty compute-bound prefill from steady HBM-bound
+    decode must shorten the decode tail, not just move work around.
+    The committed ``SERVE_DISAGG_r*.json`` artifact
+    (``tools/serve_disagg.py``, schema
+    ``apex_tpu/analysis/serve_disagg.py``) records the same sweep +
+    the replica-kill chaos drill as gate memory."""
+    del peak, warmup, iters
+    import dataclasses
+
+    import numpy as np
+
+    from apex_tpu import amp
+    from apex_tpu.models.gpt import GPTModel, gpt_small_tpu, gpt_tiny
+    from apex_tpu.obs.metrics import Registry
+    from apex_tpu.serve import (DisaggRouter, Request, RouterConfig,
+                                ServeConfig, ServeEngine)
+
+    need_devices = 1 + n_replicas
+    if len(jax.devices()) < need_devices:
+        return {"skipped": f"needs >= {need_devices} devices "
+                           f"(1 prefill + {n_replicas} decode), have "
+                           f"{len(jax.devices())}"}
+    cfg = gpt_tiny() if tiny else gpt_small_tpu()
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    a = amp.initialize(opt_level="O2", verbosity=0)
+    params = a.model_params_from(params)
+
+    concurrency = n_replicas * slots_per_replica
+    block = 16 if not tiny else 4
+    mb = -(-(prefill + new_tokens) // block)
+    scfg_rep = ServeConfig(
+        num_slots=slots_per_replica, block_size=block,
+        num_blocks=slots_per_replica * mb + 1, max_blocks_per_slot=mb,
+        prefill_chunk=min(prefill, 128 if not tiny else 8))
+    scfg_mono = dataclasses.replace(
+        scfg_rep, num_slots=concurrency,
+        num_blocks=concurrency * mb + 1)
+    rng = np.random.RandomState(11)
+
+    def make_reqs(tag):
+        # the SAME mixed-length stream hits both arms (same seed, same
+        # budgets) — the A/B isolates the topology, nothing else
+        reqs = []
+        for i in range(concurrency):
+            plen = int(prefill * (0.5 + 0.5 * (i % 2)))
+            reqs.append(Request(
+                uid=f"{tag}{i}",
+                prompt=rng.randint(0, cfg.vocab_size, (plen,)),
+                max_new_tokens=new_tokens))
+        return reqs
+
+    rng_state = rng.get_state()
+
+    # -- monolithic arm: c slots, one engine, one device set ----------
+    eng = ServeEngine(params, cfg, scfg_mono, registry=Registry())
+    hist = eng.metrics.histogram("serve_decode_step_seconds")
+    toks = eng.metrics.counter("serve_tokens_total")
+    for r in make_reqs("m"):
+        eng.submit(r)
+    eng.step()                        # admission + compile + 1 step
+    mark = hist.state()
+    tok0 = toks.value
+    t0 = time.perf_counter()
+    while not eng.sched.idle():
+        eng._admit_and_evict()
+        eng.step()
+    wall = time.perf_counter() - t0
+    mono = {
+        "num_slots": concurrency,
+        "tok_s": round((toks.value - tok0) / wall, 2) if wall else 0.0,
+        "p50_ms": round(hist.quantile(0.5, since=mark) * 1e3, 3),
+        "p99_ms": round(hist.quantile(0.99, since=mark) * 1e3, 3),
+        "steps": int(hist.count - mark[2]),
+        "retraces": eng.trace_counts["decode"],
+    }
+
+    # -- disaggregated arm: same stream, same concurrency, the fleet --
+    rng.set_state(rng_state)
+    reg = Registry()
+    router = DisaggRouter(
+        params, cfg, scfg_rep,
+        RouterConfig(n_decode_replicas=n_replicas, transfer="ship"),
+        registry=reg)
+    hists = [r.eng.metrics.histogram("serve_decode_step_seconds")
+             for r in router.replicas]
+    for r in make_reqs("d"):
+        router.submit(r)
+    router.step()                     # route + compile + 1 step each
+    marks = [h.state() for h in hists]
+    tok0 = [r.eng.metrics.counter("serve_tokens_total").value
+            for r in router.replicas]
+    t0 = time.perf_counter()
+    router.run()
+    wall = time.perf_counter() - t0
+    produced = sum(
+        r.eng.metrics.counter("serve_tokens_total").value - t
+        for r, t in zip(router.replicas, tok0))
+    per_replica = []
+    for h, mark in zip(hists, marks):
+        steps = int(h.count - mark[2])
+        per_replica.append({
+            "steps": steps,
+            "p50_ms": round(h.quantile(0.5, since=mark) * 1e3, 3)
+            if steps else 0.0,
+            "p99_ms": round(h.quantile(0.99, since=mark) * 1e3, 3)
+            if steps else 0.0,
+        })
+    disagg = {
+        "slots_per_replica": slots_per_replica,
+        "n_replicas": n_replicas,
+        "tok_s": round(produced / wall, 2) if wall else 0.0,
+        "p50_ms": round(_merged_decode_quantile(
+            list(zip(hists, marks)), 0.5) * 1e3, 3),
+        "p99_ms": round(_merged_decode_quantile(
+            list(zip(hists, marks)), 0.99) * 1e3, 3),
+        "per_replica": per_replica,
+        "retraces": [r.eng.trace_counts["decode"]
+                     for r in router.replicas],
+        "kv_transfer_bytes": int(
+            reg.counter("serve_kv_transfer_bytes").value),
+        "shipments": int(reg.counter("serve_kv_shipments_total").value),
+        "reroutes": int(reg.counter("serve_reroute_total").value),
+    }
+
+    ab_ok = disagg["p99_ms"] <= mono["p99_ms"] \
+        and mono["retraces"] == 1 \
+        and all(r == 1 for r in disagg["retraces"])
+    return {"tok_s": disagg["tok_s"], "batch": concurrency,
+            "prefill": prefill, "new_tokens": new_tokens,
+            "p50_ms": disagg["p50_ms"], "p99_ms": disagg["p99_ms"],
+            "mono": mono, "disagg": disagg,
+            "topology": {"n_devices": len(jax.devices()),
+                         **router.slices.describe()},
+            "ab_ok": bool(ab_ok)}
+
+
 def bench_pipeline_ab(warmup: int, iters: int, peak: float,
                       batch: int = 256, size: int = 64):
     """Host-input pipeline A/B at a COMPUTE-visible shape (b256/64px:
@@ -1357,6 +1537,16 @@ def main(argv=None):
         record("gpt_small_tpu_serve_c8", bench_serve, optional=True,
                warmup=1, iters=1, num_slots=8, prefill=512,
                new_tokens=128, tiny=False)
+        # disaggregated prefill/decode fleet vs the monolithic engine
+        # at EQUAL resources and the same c16 request stream: prefill
+        # on its own mesh slice, 2 decode replicas on disjoint slices,
+        # KV shipped between them; gated on the DistServe claim
+        # (disagg decode p99 <= mono p99) via ab_ok.  Skips (recorded)
+        # on hosts with fewer than 3 addressable devices.
+        record("gpt_small_tpu_serve_disagg_c16", bench_serve_disagg,
+               optional=True, warmup=1, iters=1, n_replicas=2,
+               slots_per_replica=8, prefill=512, new_tokens=128,
+               tiny=False)
         # pipeline-vs-naive at the compute-visible shape; gated on the
         # delta sign (ab_ok), not the wire-coupled absolute rate
         record("resnet50_pipeline_ab_64px", bench_pipeline_ab,

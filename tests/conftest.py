@@ -1,9 +1,12 @@
-"""Test config: force an 8-device CPU platform before jax initializes.
+"""Test config: force a 16-device CPU platform before jax initializes.
 
 This is the test strategy SURVEY.md §4.3 prescribes: every collective
 component gets a multi-device test runnable without TPU hardware via
 ``--xla_force_host_platform_device_count`` (strictly better than the
 reference, which could only test distributed paths on a multi-GPU rig).
+16 devices (was 8) carries the disaggregated-serving fleet topology —
+1 prefill slice + decode replicas on disjoint slices at the c16 bench
+shape — while every older multi-device test keeps slicing its first 8.
 """
 
 import os
@@ -11,7 +14,7 @@ import os
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+        _flags + " --xla_force_host_platform_device_count=16").strip()
 
 import jax  # noqa: E402  (import after env setup)
 

@@ -32,7 +32,10 @@ WORLD = 8
 
 @pytest.fixture(scope="module")
 def mesh():
-    return data_parallel_mesh()
+    # first WORLD devices only: the platform carries 16 virtual devices
+    # (the disaggregated-serving fleet topology); these WORLD=8-shaped
+    # tests keep their original 8-wide mesh
+    return data_parallel_mesh(num_devices=WORLD)
 
 
 def shmap(mesh, fn, in_specs, out_specs):

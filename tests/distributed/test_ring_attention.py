@@ -22,7 +22,8 @@ B, L, H, D = 2, 64, 8, 16   # L/W = 8 per device
 
 @pytest.fixture(scope="module")
 def mesh():
-    return data_parallel_mesh()
+    # first 8 devices of the 16-device test platform (L/W = 8/device)
+    return data_parallel_mesh(num_devices=8)
 
 
 def _qkv(seed=0, dtype=jnp.float32):

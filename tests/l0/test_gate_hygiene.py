@@ -475,6 +475,116 @@ def test_repo_export_validates():
     assert gate_hygiene._validate_exports(str(REPO)) == []
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 11: SERVE_DISAGG_r*.json is gate memory too
+# ---------------------------------------------------------------------------
+
+def _valid_serve_disagg():
+    return {
+        "round": 1, "platform": "cpu",
+        "config": {"model": "gpt_tiny", "concurrency": 16,
+                   "prefill": 64, "new_tokens": 16, "block_size": 4},
+        "topology": {"n_devices": 16, "transfer": "ship",
+                     "prefill_devices": [0],
+                     "replica_devices": [[1], [2]]},
+        "mono": {"num_slots": 16, "tok_s": 2000.0, "p50_ms": 8.0,
+                 "p99_ms": 12.0, "steps": 14, "retraces": 1},
+        "disagg": {"slots_per_replica": 8, "n_replicas": 2,
+                   "tok_s": 1600.0, "p50_ms": 4.0, "p99_ms": 6.0,
+                   "per_replica": [{"steps": 14, "p50_ms": 4.0,
+                                    "p99_ms": 6.0}] * 2,
+                   "kv_transfer_bytes": 655488, "shipments": 16,
+                   "reroutes": 0},
+        "chaos": {"killed_replica": 0, "rerouted": 2,
+                  "bitwise_ok": True},
+        "gate": {"p99_ok": True, "ok": True},
+    }
+
+
+def test_committed_serve_disagg_validated_against_schema(tmp_repo):
+    _analysis_module(tmp_repo, "serve_disagg")
+    (tmp_repo / "SERVE_DISAGG_r07_bad.json").write_text('{"round": 7}')
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "bad serve-disagg")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("SERVE_DISAGG_r07_bad.json" in p
+               for p in verdict["invalid_serve_disaggs"])
+    assert gate_hygiene.main(["--repo", str(tmp_repo)]) == 1
+
+
+def test_serve_disagg_contradictory_verdict_fails_hygiene(tmp_repo):
+    """The p99 gate verdict must be derivable from its own numbers: a
+    record claiming p99_ok while disagg p99 exceeds mono p99 fails
+    hygiene — the A/B cannot rot into an unearned 'ok'."""
+    _analysis_module(tmp_repo, "serve_disagg")
+    doc = _valid_serve_disagg()
+    doc["disagg"]["p99_ms"] = 20.0      # over mono's 12.0, gate says ok
+    (tmp_repo / "SERVE_DISAGG_r08_lie.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "contradictory serve-disagg")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert any("CONTRADICTORY" in p
+               for p in verdict["invalid_serve_disaggs"])
+
+
+def test_serve_disagg_overlapping_slices_fail_hygiene(tmp_repo):
+    """Disjointness is the topology's whole claim: shared devices
+    between the prefill slice and a decode replica are schema-invalid
+    (overlap fakes the disaggregation)."""
+    _analysis_module(tmp_repo, "serve_disagg")
+    doc = _valid_serve_disagg()
+    doc["topology"]["replica_devices"] = [[0], [2]]   # 0 = prefill dev
+    (tmp_repo / "SERVE_DISAGG_r09_overlap.json").write_text(
+        json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "overlapping slices")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert any("OVERLAP" in p for p in verdict["invalid_serve_disaggs"])
+
+
+def test_serve_disagg_chaos_failure_breaks_ok(tmp_repo):
+    """gate.ok over a failed chaos drill is contradictory: the fleet
+    gate includes the failure semantics, not just the latency win."""
+    _analysis_module(tmp_repo, "serve_disagg")
+    doc = _valid_serve_disagg()
+    doc["chaos"]["bitwise_ok"] = False   # gate.ok still True
+    (tmp_repo / "SERVE_DISAGG_r10_chaos.json").write_text(
+        json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "chaos contradiction")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert any("CONTRADICTORY" in p
+               for p in verdict["invalid_serve_disaggs"])
+
+
+def test_valid_serve_disagg_passes_and_untracked_fails(tmp_repo):
+    _analysis_module(tmp_repo, "serve_disagg")
+    (tmp_repo / "SERVE_DISAGG_r11_ok.json").write_text(
+        json.dumps(_valid_serve_disagg()))
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert verdict["untracked"] == ["SERVE_DISAGG_r11_ok.json"]
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "good serve-disagg")
+    assert gate_hygiene.check(str(tmp_repo))["ok"]
+
+
+def test_repo_serve_disagg_validates():
+    """The committed SERVE_DISAGG artifact is the schema's reference
+    instance; it must stay valid (and its gate must HOLD — the c16
+    acceptance bar rides this assertion)."""
+    assert gate_hygiene._validate_serve_disaggs(str(REPO)) == []
+    arts = sorted(REPO.glob("SERVE_DISAGG_r*.json"))
+    assert arts, "the disagg gate artifact must be committed"
+    doc = json.loads(arts[-1].read_text())
+    assert doc["gate"]["ok"] is True
+    assert doc["disagg"]["p99_ms"] <= doc["mono"]["p99_ms"]
+    assert doc["chaos"]["bitwise_ok"] is True
+    assert doc["topology"]["n_devices"] >= 16
+    assert doc["config"]["concurrency"] >= 16
+
+
 def test_real_committed_convergence_artifacts_validate():
     """Every CONVERGENCE_r*.json in the real repo — the legacy r02
     shape through the r06 quant lanes — validates."""

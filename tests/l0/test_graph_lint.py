@@ -566,15 +566,27 @@ ENTRY_POINTS = ([_entry_param(f, o)
                  for o in ["O1", "O2"]]
                 + [_entry_param("decode_b1", None),
                    _entry_param("decode_b2", None),
-                   _entry_param("serve_step", None)])
+                   _entry_param("serve_step", None),
+                   # the disaggregated fleet's split steps: the prefill
+                   # worker's chunk program stays tier-1 (a new program
+                   # class); the replica-shaped decode lane duplicates
+                   # serve_step's program class at another geometry and
+                   # rides the slow lane (tier-1 budget)
+                   _entry_param("serve_prefill", None),
+                   pytest.param("serve_decode", None, id="serve_decode",
+                                marks=(pytest.mark.slow,))])
 
 
 @pytest.mark.parametrize("name,opt_level", ENTRY_POINTS)
 def test_every_entry_point_lints_clean(name, opt_level):
     import graph_lint
     if opt_level is None:
-        lint = graph_lint.lint_serve if name in graph_lint.SERVE_LANES \
-            else graph_lint.lint_decode
+        if name in graph_lint.SERVE_PREFILL_LANES:
+            lint = graph_lint.lint_serve_prefill
+        elif name in graph_lint.SERVE_LANES:
+            lint = graph_lint.lint_serve
+        else:
+            lint = graph_lint.lint_decode
         report = lint(
             name, memory_budget=graph_lint.memory_mod.V5E_HBM_BYTES)
     else:

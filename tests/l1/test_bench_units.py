@@ -621,3 +621,61 @@ def test_bench_serve_tiny_cpu():
     levels = r["offered_load"]
     assert set(levels) == {"c1", "c2"}
     assert all(v["retraces"] == 1 for v in levels.values())
+
+
+def test_merged_decode_quantile_unions_replica_windows():
+    """The fleet percentile is the union of the replicas' histogram
+    windows through the SAME Histogram interpolation — two replicas
+    with disjoint latency populations must merge to the population
+    quantile, and pre-mark observations stay outside the window."""
+    from apex_tpu.obs.metrics import Histogram, Registry
+
+    reg = Registry()
+    h1, h2 = Histogram(reg, "a"), Histogram(reg, "b")
+    h1.observe(10.0)                    # pre-window (compile step)
+    m1, m2 = h1.state(), h2.state()
+    for _ in range(50):
+        h1.observe(0.001)
+        h2.observe(0.004)
+    merged_p50 = bench._merged_decode_quantile([(h1, m1), (h2, m2)],
+                                               0.5)
+    merged_p99 = bench._merged_decode_quantile([(h1, m1), (h2, m2)],
+                                               0.99)
+    # half the union sits near 1 ms, the slow half near 4 ms: p50
+    # lands between the two modes, p99 inside the slow replica's
+    # bucket — and far under the excluded 10 s compile outlier
+    assert 0.0005 < merged_p50 < 0.004
+    assert 0.002 < merged_p99 < 0.01
+    # stale-max guard: an overflow-bucket observation AFTER the mark
+    # must interpolate toward the window's own max, never toward the
+    # excluded pre-mark outlier — merged and single-histogram math
+    # must agree exactly (h3's 100 s compile vs a 30 s window step)
+    h3 = Histogram(reg, "c")
+    h3.observe(100.0)
+    m3 = h3.state()
+    h3.observe(30.0)
+    merged = bench._merged_decode_quantile([(h3, m3)], 0.99)
+    assert merged == h3.quantile(0.99, since=m3)
+    assert merged <= 30.0
+
+
+@pytest.mark.slow
+def test_bench_serve_disagg_tiny_cpu():
+    """The disaggregated A/B path end-to-end on CPU: both arms serve
+    the same stream, percentiles come from the engines' own
+    histograms, the topology records disjoint slices, and every
+    program keeps one trace.  (The committed SERVE_DISAGG artifact —
+    generated by tools/serve_disagg.py at the full c16 shape — is the
+    gated instance; this is the code-path smoke.)"""
+    r = bench.bench_serve_disagg(warmup=1, iters=1, peak=None,
+                                 n_replicas=2, slots_per_replica=2,
+                                 prefill=16, new_tokens=8, tiny=True)
+    assert "skipped" not in r, r
+    assert r["mono"]["retraces"] == 1
+    assert r["disagg"]["retraces"] == [1, 1]
+    assert r["disagg"]["shipments"] == r["batch"] == 4
+    assert r["disagg"]["kv_transfer_bytes"] > 0
+    flat = r["topology"]["prefill"] + [
+        d for rep in r["topology"]["decode"] for d in rep]
+    assert len(flat) == len(set(flat))
+    assert r["p99_ms"] >= r["p50_ms"] > 0

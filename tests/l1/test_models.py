@@ -123,7 +123,8 @@ class TestResNet:
 
     def test_sync_bn_conversion_and_ddp_step(self):
         from apex_tpu.parallel import convert_syncbn_model
-        mesh = data_parallel_mesh()
+        # first 8 devices: the x8 batch shards over an 8-wide mesh
+        mesh = data_parallel_mesh(num_devices=8)
         sync_model = convert_syncbn_model(self.model, axis_name="data")
         assert sync_model.bn_axis_name == "data"
         variables = sync_model.init(jax.random.PRNGKey(0), self.x, train=True)

@@ -56,7 +56,14 @@ This check fails (exit 1) when
   bitwise round trip, is a CONTRADICTORY verdict and schema-invalid —
   refused lanes naming the documented finding id, and a ``cold_start``
   block whose ``ok`` agrees with its own load-vs-compile numbers) —
-  the executable cache's build evidence is gate memory too.
+  the executable cache's build evidence is gate memory too, or
+- a committed ``SERVE_DISAGG_r*.json`` does not validate against the
+  disaggregated-serving schema (``apex_tpu/analysis/serve_disagg.py``:
+  disjoint slice topology, both arms' percentile records, the chaos
+  drill, and a ``gate`` whose ``p99_ok``/``ok`` AGREE with the
+  recorded numbers — a verdict contradicting its own A/B is
+  schema-invalid) — the p99 gate of the disaggregated fleet is gate
+  memory like every other floor.
 
 It is wired into tier-1 (``tests/l0/test_gate_hygiene.py``), so a round
 cannot go green with dirty gate memory.  Best-effort on the VCS side:
@@ -90,7 +97,8 @@ PATTERNS = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json",
             "BENCH_r*.json", "INCIDENT_r*.json", "MEMLINT_r*.json",
             "PRECLINT_r*.json", "DECODE_DECOMPOSE_r*.json",
             "OBS_r*.json", "DECODE_PROFILE_r*.json",
-            "CONVERGENCE_r*.json", "EXPORT_r*.json")
+            "CONVERGENCE_r*.json", "EXPORT_r*.json",
+            "SERVE_DISAGG_r*.json")
 
 #: Round-numbered incident artifacts additionally get schema-validated.
 INCIDENT_PATTERN = "INCIDENT_r*.json"
@@ -113,8 +121,11 @@ PROFILE_PATTERN = "DECODE_PROFILE_r*.json"
 #: ... and the convergence-evidence artifacts ...
 CONVERGENCE_PATTERN = "CONVERGENCE_r*.json"
 
-#: ... and the AOT-export artifacts.
+#: ... and the AOT-export artifacts ...
 EXPORT_PATTERN = "EXPORT_r*.json"
+
+#: ... and the disaggregated-serving gate artifacts.
+SERVE_DISAGG_PATTERN = "SERVE_DISAGG_r*.json"
 
 
 def _load_by_path(repo: str, *rel: str):
@@ -245,6 +256,21 @@ def _validate_exports(repo: str) -> "list[str]":
     return problems
 
 
+def _validate_serve_disaggs(repo: str) -> "list[str]":
+    """Schema problems over every present SERVE_DISAGG_r*.json, as
+    ``path: problem`` strings
+    (``apex_tpu/analysis/serve_disagg.py``)."""
+    schema = _load_by_path(repo, "apex_tpu", "analysis",
+                           "serve_disagg.py")
+    if schema is None:
+        return []
+    problems = []
+    for p in sorted(Path(repo).glob(SERVE_DISAGG_PATTERN)):
+        for msg in schema.validate_serve_disagg_file(str(p)):
+            problems.append(f"{p.name}: {msg}")
+    return problems
+
+
 def _git(repo: str, *args: str) -> "str | None":
     """stdout of a git command, or None when git/The repo is unavailable
     (the best-effort contract)."""
@@ -272,7 +298,7 @@ def check(repo: str = str(REPO)) -> dict:
                 "invalid_memlints": [], "invalid_preclints": [],
                 "invalid_decomposes": [], "invalid_obs": [],
                 "invalid_profiles": [], "invalid_convergences": [],
-                "invalid_exports": []}
+                "invalid_exports": [], "invalid_serve_disaggs": []}
     tracked = set(tracked_raw.split())
     missing = [f for f in REQUIRED
                if not (Path(repo) / f).exists() or f not in tracked]
@@ -300,10 +326,11 @@ def check(repo: str = str(REPO)) -> dict:
     invalid_prof = _validate_profiles(repo)
     invalid_conv = _validate_convergences(repo)
     invalid_exp = _validate_exports(repo)
+    invalid_disagg = _validate_serve_disaggs(repo)
     return {"ok": not (missing or untracked or dirty or invalid
                        or invalid_mem or invalid_prec or invalid_dec
                        or invalid_obs or invalid_prof or invalid_conv
-                       or invalid_exp),
+                       or invalid_exp or invalid_disagg),
             "missing": missing, "untracked": untracked, "dirty": dirty,
             "invalid_incidents": invalid,
             "invalid_memlints": invalid_mem,
@@ -312,7 +339,8 @@ def check(repo: str = str(REPO)) -> dict:
             "invalid_obs": invalid_obs,
             "invalid_profiles": invalid_prof,
             "invalid_convergences": invalid_conv,
-            "invalid_exports": invalid_exp}
+            "invalid_exports": invalid_exp,
+            "invalid_serve_disaggs": invalid_disagg}
 
 
 def main(argv=None) -> int:
@@ -335,7 +363,9 @@ def main(argv=None) -> int:
               f"{verdict.get('invalid_profiles', [])}; invalid "
               f"convergence records "
               f"{verdict.get('invalid_convergences', [])}; invalid "
-              f"export records {verdict.get('invalid_exports', [])}",
+              f"export records {verdict.get('invalid_exports', [])}; "
+              f"invalid serve-disagg records "
+              f"{verdict.get('invalid_serve_disaggs', [])}",
               file=sys.stderr)
         return 1
     return 0

@@ -18,11 +18,16 @@ Runs every :mod:`apex_tpu.analysis` pass over the four model families
   configs, and ``--emit-json`` additionally lowers the
   ``dryrun_multichip`` slices on the 8-device virtual CPU mesh to
   record each slice's static per-device HBM;
-- the **serve lane** lints the continuous-batching engine's compiled
-  decode step (``apex_tpu.serve.ServeEngine``: paged KV pools, page
+- the **serve lanes** lint the continuous-batching engine's compiled
+  programs (``apex_tpu.serve.ServeEngine``: paged KV pools, page
   tables, fused sampling epilogue, donated carries) — the serving
   static-shape contract's static half: no host callback and no
-  retrace hazard on the token loop.
+  retrace hazard on the token loop.  Since the disaggregated fleet
+  (``apex_tpu.serve.router``) split the phases onto separate mesh
+  slices, the lane family covers BOTH split steps: ``serve_step``
+  (monolithic shape) + ``serve_decode`` (decode-replica shape) for
+  the decode program, and ``serve_prefill`` for the prefill worker's
+  chunked-prefill program.
 
 Per-family collective byte budgets are pinned at zero: a single-chip
 train step has no collectives, so ANY appearing is a comm-volume
@@ -143,7 +148,20 @@ DECODE_LANES = {"decode_b1": (1, 8, 8, None),
 #: numeric scalar (either would serialize or retrace the serving
 #: fleet's hot loop); the runtime half (one trace across a whole
 #: admit/retire stream) lives in tests/l0/test_serve_engine.py.
-SERVE_LANES = {"serve_step": (2, 4, 9, 4)}
+#: ``serve_step`` is the monolithic engine's shape; ``serve_decode``
+#: is the SAME program class at a disaggregated decode-replica shape
+#: (``apex_tpu.serve.router.DecodeReplica`` — more slots, its own
+#: pool), so the split fleet's decode half is machine-checked at its
+#: own geometry.
+SERVE_LANES = {"serve_step": (2, 4, 9, 4),
+               "serve_decode": (4, 4, 17, 4)}
+
+#: the split fleet's OTHER compiled program: the prefill worker's
+#: chunked prefill (``ServeEngine._prefill_chunk`` — what
+#: ``apex_tpu.serve.router.PrefillWorker`` dispatches per chunk on the
+#: prefill mesh slice).  Same tuple shape as SERVE_LANES; the chunk
+#: length is the config's ``prefill_chunk`` (= block_size here).
+SERVE_PREFILL_LANES = {"serve_prefill": (2, 4, 9, 4)}
 
 
 def build_train_step(family: str, raw=None, opt_level: str = "O1"):
@@ -218,19 +236,46 @@ def build_serve_step(num_slots: int = 2, block_size: int = 4,
     return eng._decode_step, args, a.properties
 
 
-def lint_serve(lane: str, passes=None, compile: bool = True,
-               memory_budget=None, _collect=None):
-    """Lint one serve lane (graph + memlint + precision passes; no
-    policy — the serving step is a bf16 forward by design, like the
-    decode lanes)."""
+def build_serve_prefill(num_slots: int = 2, block_size: int = 4,
+                        num_blocks: int = 9,
+                        max_blocks_per_slot: int = 4):
+    """(jitted_chunk, args, properties): the serve engine's compiled
+    chunked-prefill program — one ``(1, prefill_chunk)`` prompt chunk
+    written through a slot's page table, KV pools donated — the
+    program the disaggregated fleet's prefill worker dispatches on its
+    own mesh slice.  ``start``/``n_valid`` are DYNAMIC int32 args
+    (one executable per chunk shape, never per position)."""
+    from apex_tpu.models.gpt import GPTModel, gpt_tiny
+    from apex_tpu.serve import ServeConfig, ServeEngine
+
+    cfg = gpt_tiny()
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    a = amp.initialize(opt_level="O2", verbosity=0)
+    params = a.model_params_from(params)
+    scfg = ServeConfig(num_slots=num_slots, block_size=block_size,
+                       num_blocks=num_blocks,
+                       max_blocks_per_slot=max_blocks_per_slot,
+                       prefill_chunk=block_size)
+    eng = ServeEngine(params, cfg, scfg)
+    s = eng.sched
+    args = (eng.top, eng.stacked, eng.carry["kc"], eng.carry["vc"],
+            eng.carry.get("ks"), eng.carry.get("vs"),
+            jnp.asarray(s.page_table[0]),
+            jnp.zeros((1, scfg.prefill_chunk), jnp.int32),
+            jnp.int32(0), jnp.int32(scfg.prefill_chunk))
+    return eng._prefill_chunk, args, a.properties
+
+
+def _lint_serve_program(lane: str, fn, args, props, passes, compile,
+                        memory_budget, _collect):
     passes = tuple(
         p for p in (passes or GRAPH_PASSES + MEMLINT_PASSES
                     + ("precision",))
         if p != "policy")
     if not passes:
         return analysis.Report()
-    slots, bs, nb, mb = SERVE_LANES[lane]
-    fn, args, props = build_serve_step(slots, bs, nb, mb)
     lowered = analysis.lower_quiet(fn, *args)
     ctx = analysis.build_context(lowered, compile=compile, policy=props)
     options = {"collectives": {"budget": {"total": 0}}}
@@ -239,6 +284,34 @@ def lint_serve(lane: str, passes=None, compile: bool = True,
     if _collect is not None:
         _collect[lane] = _lane_record(ctx, report)
     return report
+
+
+def lint_serve(lane: str, passes=None, compile: bool = True,
+               memory_budget=None, _collect=None):
+    """Lint one serve decode-step lane (graph + memlint + precision
+    passes; no policy — the serving step is a bf16 forward by design,
+    like the decode lanes)."""
+    if passes is not None and not tuple(p for p in passes
+                                        if p != "policy"):
+        return analysis.Report()
+    slots, bs, nb, mb = SERVE_LANES[lane]
+    fn, args, props = build_serve_step(slots, bs, nb, mb)
+    return _lint_serve_program(lane, fn, args, props, passes, compile,
+                               memory_budget, _collect)
+
+
+def lint_serve_prefill(lane: str, passes=None, compile: bool = True,
+                       memory_budget=None, _collect=None):
+    """Lint one serve prefill-chunk lane — the split fleet's other
+    compiled program, under the same pass matrix as the decode
+    lanes."""
+    if passes is not None and not tuple(p for p in passes
+                                        if p != "policy"):
+        return analysis.Report()
+    slots, bs, nb, mb = SERVE_PREFILL_LANES[lane]
+    fn, args, props = build_serve_prefill(slots, bs, nb, mb)
+    return _lint_serve_program(lane, fn, args, props, passes, compile,
+                               memory_budget, _collect)
 
 
 def _memlint_options(memory_budget=None):
@@ -441,6 +514,12 @@ def emit_memlint(path: str, families, memory_budget=None,
         n_errors += len(rep.errors)
         if verbose:
             print(f"--- {lane} ---\n{rep.format()}", file=sys.stderr)
+    for lane in SERVE_PREFILL_LANES:
+        rep = lint_serve_prefill(lane, memory_budget=memory_budget,
+                                 _collect=lanes)
+        n_errors += len(rep.errors)
+        if verbose:
+            print(f"--- {lane} ---\n{rep.format()}", file=sys.stderr)
 
     calibration = _calibration_audit()
     n_errors += sum(1 for f in calibration if f.severity == "error")
@@ -507,6 +586,11 @@ def emit_preclint(path: str, families, verbose: bool = False) -> int:
         record(lane, ctx)
     for lane, (slots, bs, nb, mb) in SERVE_LANES.items():
         fn, args, props = build_serve_step(slots, bs, nb, mb)
+        lowered = analysis.lower_quiet(fn, *args)
+        ctx = analysis.build_context(lowered, compile=False, policy=props)
+        record(lane, ctx)
+    for lane, (slots, bs, nb, mb) in SERVE_PREFILL_LANES.items():
+        fn, args, props = build_serve_prefill(slots, bs, nb, mb)
         lowered = analysis.lower_quiet(fn, *args)
         ctx = analysis.build_context(lowered, compile=False, policy=props)
         record(lane, ctx)
@@ -722,6 +806,10 @@ def main(argv=None) -> int:
     if "serve" in lanes:
         for lane in SERVE_LANES:
             run(lane, lambda ln=lane: lint_serve(
+                ln, passes=passes, compile=not opts.no_compile,
+                memory_budget=budget))
+        for lane in SERVE_PREFILL_LANES:
+            run(lane, lambda ln=lane: lint_serve_prefill(
                 ln, passes=passes, compile=not opts.no_compile,
                 memory_budget=budget))
     if failed:
