@@ -42,6 +42,64 @@ from apex_tpu.ops.rope import apply_rope, rope_tables
 NEG_INF = -1e30
 
 
+def greedy_argmax(logits: jax.Array) -> jax.Array:
+    """Lowest-index argmax over the last axis, REASSOCIATION-PROOF:
+    ``(..., V) -> (...) i32``.
+
+    ``jnp.argmax``'s tie-breaking is not stable across fusion
+    contexts: XLA may partition the reduction differently depending on
+    what the argmax is fused with, and on XLA:CPU an EXACT logit tie
+    (two bf16 logits with the same value — observed on a real gpt_tiny
+    stream, PR 10's verification drive) resolved to the LOWER index
+    when the logits were a program output but the HIGHER index inside
+    the serve engine's fused sampling epilogue, making batched decode
+    greedy-diverge from solo ``generate()`` with bitwise-identical
+    caches and bitwise-identical logits.  This helper pins the
+    convention structurally instead of trusting the backend: ``max``
+    is exact (no rounding, fully associative over floats), the
+    equality compare is exact, and the index ``min`` is an integer
+    reduction — every step is reassociation-safe, so the lowest tied
+    index wins under ANY fusion, batch width, or backend.  Every
+    greedy pick on a parity-pinned path (solo ``generate()``, the
+    serve sampling epilogue, the speculative-decoding verifier) MUST
+    route through this one function — the serve-vs-solo bitwise
+    contract lives here.
+
+    An all-NaN row (a numerically-poisoned forward — precondition
+    violation, not a supported state) matches nothing (NaN != NaN);
+    the clamp keeps the returned id in-vocabulary (``v - 1``,
+    arbitrary like ``jnp.argmax``'s 0 was) instead of emitting an
+    out-of-range token into the stream."""
+    v = logits.shape[-1]
+    mx = jnp.max(logits, axis=-1, keepdims=True)
+    idx = jnp.arange(v, dtype=jnp.int32)
+    cand = jnp.where(logits == mx, idx, jnp.int32(v))
+    return jnp.minimum(jnp.min(cand, axis=-1), v - 1)
+
+
+def pin_logits(logits: jax.Array) -> jax.Array:
+    """Materialize the lm-head logits ONCE per program
+    (``lax.optimization_barrier``) so every consumer reads the same
+    buffer.
+
+    The companion hazard to :func:`greedy_argmax`'s tie instability:
+    on XLA:CPU a bf16 matmul lowers to a fusable loop (not an opaque
+    GEMM call), so when the logits have several consumers — the
+    program output AND a fused sampling epilogue — XLA may
+    REMATERIALIZE the matmul per consumer with different blocking,
+    and the two copies of the "same" logit can differ in the last
+    ulp.  Observed for real (PR 10 drive + this PR's stress streams):
+    a near-tied logit pair ranked one way in the returned buffer and
+    the other way inside the fused sampler, greedy-diverging batched
+    decode from solo ``generate()`` with bitwise-identical caches.
+    The barrier forbids fusing/recomputing ACROSS it, so the matmul
+    runs exactly once and sampler, argmax, and output all see that
+    one result.  Every lm-head logits production on a parity-pinned
+    path (solo decode, serve decode/prefill, the speculative-decoding
+    verifier) must wrap itself in this."""
+    return jax.lax.optimization_barrier(logits)
+
+
 def _concrete_zero(v) -> bool:
     """True iff ``v`` is statically known to be 0: a Python/numpy int,
     or a CONCRETE 0-d array (``jnp.int32(0)`` from a caller that keeps
@@ -240,7 +298,7 @@ def _forward_cached(params, stacked, cfg, ids, kc, vc, start: int,
     (x, kc, vc, ks, vs), _ = jax.lax.scan(
         layer, (x, kc, vc, ks, vs), (stacked, jnp.arange(c.num_layers)))
     x = _ln(x[:, -1:], params["ln_f"], c.layer_norm_eps)
-    logits = x[:, 0] @ params["lm_head"]["kernel"]
+    logits = pin_logits(x[:, 0] @ params["lm_head"]["kernel"])
     return logits, kc, vc, ks, vs
 
 
@@ -313,7 +371,10 @@ def _generate_impl(top, stacked, prompt_ids, temperature, rng, *,
         if sample:
             return jax.random.categorical(
                 key, logits.astype(jnp.float32) / temperature, axis=-1)
-        return jnp.argmax(logits, axis=-1)
+        # the shared tie-stable greedy pick (see greedy_argmax): solo
+        # and serve MUST break exact logit ties identically or the
+        # bitwise parity contract dies on tied bf16 logits
+        return greedy_argmax(logits.astype(jnp.float32))
 
     rng, key0 = jax.random.split(rng)
     first = pick(logits, key0).astype(prompt_ids.dtype)

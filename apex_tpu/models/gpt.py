@@ -239,3 +239,42 @@ def lm_loss(logits: jax.Array, targets: jax.Array,
     if seq_axis_name is not None:
         count = jax.lax.psum(count, seq_axis_name)
     return total / jnp.maximum(count, 1.0)
+
+
+def train_toy_lm(cfg=None, steps: int = 50, period: int = 16):
+    """``(cfg, params, ids)``: a gpt_tiny BRIEFLY TRAINED on a
+    periodic token stream, in the bf16 O2 serving layout, plus the
+    ``(8, 64)`` int32 training ids its prompts should come from.
+
+    The shared fixture behind every test/bench/tool that needs a
+    model with REAL argmax margins (``tests/l0/test_serve_spec.py``,
+    ``tests/l0/test_quant.py``'s tolerance checks,
+    ``bench.bench_serve_spec``, ``tools/serve_scenarios.py``): a
+    random-init model's near-uniform logits put ulp/quantization
+    noise above the margins — measuring tie-breaking, not the thing
+    under test — and make speculative acceptance structurally
+    ~1/vocab.  ONE recipe (seed 8, FusedAdam lr 3e-3, ``steps``
+    steps on ``(arange * 7) % period``) keeps every consumer
+    measuring the same model; imports are lazy so the models module
+    stays importable without the amp/optimizer stack."""
+    from apex_tpu import amp
+    from apex_tpu.optimizers import FusedAdam
+
+    cfg = cfg or gpt_tiny()
+    model = GPTModel(cfg)
+    ids = (jnp.arange(8 * 64).reshape(8, 64) * 7) % period
+    params = model.init(jax.random.PRNGKey(8),
+                        ids[:1, :8].astype(jnp.int32))["params"]
+    a = amp.initialize(optimizer=FusedAdam(lr=3e-3), opt_level="O2",
+                       verbosity=0)
+    state = a.init(params)
+
+    def loss_fn(p, xb):
+        logits = model.apply({"params": p}, xb)
+        return lm_loss(logits[:, :-1], xb[:, 1:])
+
+    step = jax.jit(amp.make_train_step(a, loss_fn))
+    for _ in range(steps):
+        state, _m = step(state, ids.astype(jnp.int32))
+    import numpy as np
+    return cfg, a.model_params(state), np.asarray(ids, np.int32)

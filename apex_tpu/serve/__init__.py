@@ -30,6 +30,7 @@ from apex_tpu.serve.router import (
 )
 from apex_tpu.serve.sampling import advance_key, sample_tokens
 from apex_tpu.serve.scheduler import Request, SlotScheduler
+from apex_tpu.serve.spec import SpecConfig, SpecEngine, truncated_draft
 from apex_tpu.serve.transfer import (
     FleetSlices,
     KVShipment,
@@ -50,6 +51,8 @@ __all__ = [
     "ServeConfig",
     "ServeEngine",
     "SlotScheduler",
+    "SpecConfig",
+    "SpecEngine",
     "TRASH_BLOCK",
     "advance_key",
     "gather_slot_kv",
@@ -59,4 +62,5 @@ __all__ = [
     "ship",
     "slice_fleet",
     "token_write_coords",
+    "truncated_draft",
 ]

@@ -49,6 +49,7 @@ import numpy as np
 from apex_tpu.models.generate import (
     _ln,
     _stack_layer_params,
+    pin_logits,
 )
 from apex_tpu.obs import metrics as obs_metrics
 from apex_tpu.obs import spans
@@ -180,6 +181,58 @@ def _paged_block(x, p_l, cfg: GPTConfig, kc, vc, layer_i, cos, sin,
     x = x + (h @ p_l["ffn_out"]["kernel"]
              + p_l["ffn_out"]["bias"].astype(h.dtype))
     return x, kc, vc, ks, vs, err
+
+
+def chunk_prefill_math(cfg: GPTConfig, block_size: int,
+                       max_blocks_per_slot: int, top, stacked, kc, vc,
+                       ks, vs, table_row, chunk_ids, start, n_valid):
+    """One ``(1, C)`` prompt chunk written through a slot's page-table
+    row at global positions ``start..``, returning ``(kc, vc, ks, vs,
+    last-valid-token logits (1, V), kv_err)``.  Rows past ``n_valid``
+    are padding: their writes route to the trash block and their
+    outputs are never read.  The ONE copy of the chunked-prefill
+    coordinate/mask discipline — the engine's prefill chunk AND the
+    speculative draft's prompt prefill (a different model over its
+    own pools, which discards the logits so XLA dead-code-eliminates
+    the head matmul) both delegate here; the parity-critical paged
+    write/mask logic must not fork per caller."""
+    c = cfg
+    bs = block_size
+    mb = max_blocks_per_slot
+    head_dim = c.hidden_size // c.num_heads
+    scale = 1.0 / float(head_dim) ** 0.5
+    _, lq = chunk_ids.shape
+    m = mb * bs
+
+    x = top["tok_emb"]["embedding"][chunk_ids]             # (1,C,E)
+    pos = start + jnp.arange(lq)                           # (C,)
+    cos, sin = rope_tables(pos[None, :], head_dim, c.rope_theta)
+    in_chunk = jnp.arange(lq) < n_valid
+    blocks = jnp.where(
+        in_chunk, table_row[jnp.clip(pos // bs, 0, mb - 1)],
+        TRASH_BLOCK)
+    offs = pos % bs
+    # causal-vs-cache mask: cache slots <= the row's global
+    # position (history AND in-chunk causality at once)
+    valid = (jnp.arange(m)[None, :] <= pos[:, None])[None]  # (1,C,M)
+
+    def layer(lcarry, inputs):
+        x, kc, vc, ks, vs, esum = lcarry
+        p_l, layer_i = inputs
+        x, kc, vc, ks, vs, err = _paged_block(
+            x, p_l, c, kc, vc, layer_i, cos, sin, blocks, offs,
+            table_row[None], valid, scale, ks=ks, vs=vs)
+        esum = esum + (err if err is not None else 0.0)
+        return (x, kc, vc, ks, vs, esum), None
+
+    (x, kc, vc, ks, vs, esum), _ = jax.lax.scan(
+        layer, (x, kc, vc, ks, vs, jnp.asarray(0.0, jnp.float32)),
+        (stacked, jnp.arange(c.num_layers)))
+    x_last = jax.lax.dynamic_slice_in_dim(x, n_valid - 1, 1, axis=1)
+    x_last = _ln(x_last, top["ln_f"], c.layer_norm_eps)
+    logits = pin_logits(
+        x_last[:, 0] @ top["lm_head"]["kernel"])           # (1,V)
+    return kc, vc, ks, vs, logits, esum / c.num_layers
 
 
 class ServeEngine:
@@ -385,7 +438,7 @@ class ServeEngine:
             layer, (x, kc, vc, ks, vs),
             (stacked, jnp.arange(c.num_layers)))
         x = _ln(x[:, -1:], top["ln_f"], c.layer_norm_eps)
-        logits = x[:, 0] @ top["lm_head"]["kernel"]            # (S,V)
+        logits = pin_logits(x[:, 0] @ top["lm_head"]["kernel"])  # (S,V)
         toks, new_keys = sampling.sample_tokens(logits, keys, temp,
                                                 top_k, top_p)
         toks = jnp.where(active, toks, tokens)
@@ -412,42 +465,10 @@ class ServeEngine:
 
     def _prefill_math(self, top, stacked, kc, vc, ks, vs, table_row,
                       chunk_ids, start, n_valid):
-        c = self.cfg
-        bs = self.scfg.block_size
-        mb = self.scfg.max_blocks_per_slot
-        head_dim = c.hidden_size // c.num_heads
-        scale = 1.0 / float(head_dim) ** 0.5
-        _, lq = chunk_ids.shape
-        m = mb * bs
-
-        x = top["tok_emb"]["embedding"][chunk_ids]             # (1,C,E)
-        pos = start + jnp.arange(lq)                           # (C,)
-        cos, sin = rope_tables(pos[None, :], head_dim, c.rope_theta)
-        in_chunk = jnp.arange(lq) < n_valid
-        blocks = jnp.where(
-            in_chunk, table_row[jnp.clip(pos // bs, 0, mb - 1)],
-            TRASH_BLOCK)
-        offs = pos % bs
-        # causal-vs-cache mask: cache slots <= the row's global
-        # position (history AND in-chunk causality at once)
-        valid = (jnp.arange(m)[None, :] <= pos[:, None])[None]  # (1,C,M)
-
-        def layer(lcarry, inputs):
-            x, kc, vc, ks, vs, esum = lcarry
-            p_l, layer_i = inputs
-            x, kc, vc, ks, vs, err = _paged_block(
-                x, p_l, c, kc, vc, layer_i, cos, sin, blocks, offs,
-                table_row[None], valid, scale, ks=ks, vs=vs)
-            esum = esum + (err if err is not None else 0.0)
-            return (x, kc, vc, ks, vs, esum), None
-
-        (x, kc, vc, ks, vs, esum), _ = jax.lax.scan(
-            layer, (x, kc, vc, ks, vs, jnp.asarray(0.0, jnp.float32)),
-            (stacked, jnp.arange(c.num_layers)))
-        x_last = jax.lax.dynamic_slice_in_dim(x, n_valid - 1, 1, axis=1)
-        x_last = _ln(x_last, top["ln_f"], c.layer_norm_eps)
-        logits = x_last[:, 0] @ top["lm_head"]["kernel"]       # (1,V)
-        return kc, vc, ks, vs, logits, esum / c.num_layers
+        return chunk_prefill_math(
+            self.cfg, self.scfg.block_size,
+            self.scfg.max_blocks_per_slot, top, stacked, kc, vc, ks,
+            vs, table_row, chunk_ids, start, n_valid)
 
     # -- host loop -----------------------------------------------------
 

@@ -27,7 +27,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.models.generate import NEG_INF
+from apex_tpu.models.generate import NEG_INF, greedy_argmax
 
 
 _ADVANCE = None
@@ -65,7 +65,12 @@ def sample_tokens(logits: jax.Array, keys: jax.Array,
     mixing greedy/sampling slots reuses the one compiled program."""
     logits = logits.astype(jnp.float32)
     s, v = logits.shape
-    greedy = jnp.argmax(logits, axis=-1)
+    # tie-STABLE greedy pick (models.generate.greedy_argmax): a plain
+    # jnp.argmax breaks exact logit ties differently depending on what
+    # XLA fuses it with — observed flipping a tied bf16 logit pair
+    # between this fused epilogue and solo generate()'s program, the
+    # one way a bitwise-identical cache can still greedy-diverge
+    greedy = greedy_argmax(logits)
 
     # temperature guard: the scaled logits only reach the output for
     # slots with temperature > 0, but the divide must stay finite for

@@ -1,0 +1,466 @@
+"""Speculative decoding for the serve engine: a draft proposes, the
+target verifies a whole block of tokens in ONE compiled step.
+
+int8 KV (PR 8) halved decode's HBM traffic; this module converts spare
+decode bandwidth into tokens/step the classic way (Leviathan et al.
+2023; Chen & Borgeaud et al. 2023): a cheap **draft** model proposes
+``k`` tokens per slot, the **target** scores all of them in one
+``b×(k+1)`` forward — the chunked multi-token cached path PR 6 built
+for prefill, pointed at generation — and per-slot acceptance keeps a
+prefix and emits the target's own token at the first rejection.  Every
+accepted token saves one full decode dispatch of the target.
+
+**Exactness, the strong form.**  Classic speculative decoding argues
+distribution-level exactness: rejection sampling over draft/target
+probabilities leaves the OUTPUT DISTRIBUTION exactly the target's.
+This engine pins something stronger — bitwise STREAM equality with the
+non-speculative engine (and, greedily, with solo
+:func:`apex_tpu.models.generate.generate`) — by exploiting a property
+the serve engine already has: per-slot PRNG chains advance exactly one
+draw per emitted token (:func:`apex_tpu.serve.sampling.advance_key`).
+The verifier therefore KNOWS every draw the non-spec engine would have
+made: position ``i`` of the verified block is sampled with the slot
+chain's key at position ``n+i`` through the very same fused epilogue
+(:func:`~apex_tpu.serve.sampling.sample_tokens`, one ``(S, V)`` row at
+a time — the exact program shape the baseline step samples with).
+Acceptance is *token match*: proposal ``d_i`` is accepted iff it
+equals the target's own draw ``e_i``; at the first mismatch the
+target's draw IS the emitted token (the "resample" — drawn from the
+target distribution at the rejected position, as rejection sampling
+requires).  Emitted streams are then token-for-token the non-spec
+engine's — greedy slots match solo ``generate()`` bitwise (the
+tie-stable :func:`~apex_tpu.models.generate.greedy_argmax` +
+:func:`~apex_tpu.models.generate.pin_logits` discipline), sampled
+slots match the baseline engine bitwise, and the distribution-
+exactness argument is a one-liner: the stream *is* the target's
+stream.  The draft model can be arbitrarily wrong and only ever costs
+acceptance rate, never correctness.
+
+**KV rollback without copies.**  The verify step writes target KV for
+all ``k+1`` fed tokens at positions ``L..L+k`` through the paged block
+pool.  When only ``j <= k`` proposals are accepted the per-slot
+LENGTH simply rewinds to ``L+j+1``: positions beyond hold stale
+rejected-token KV, but the validity mask (``cache position <=
+slot length``) re-masks them and the next round's writes overwrite
+them before they could ever be unmasked — the same trash-block
+discipline that already covers inactive slots.  No copy, no scatter,
+no shape change.
+
+**Static shapes, two programs.**  The draft's ``k``-token proposal
+loop is ONE compiled step (a ``lax.scan`` over ``k`` single-token
+paged decode steps on the draft's own pools, sharing the slot page
+tables), and the verifier is ONE compiled ``b×(k+1)`` step; both are
+shaped by config alone, so admission/retirement/preemption never
+retrace either (``trace_counts`` pins it at runtime; the graph-lint
+``serve_verify`` lane pins the verifier statically).
+
+The draft shares the target's page-table geometry: its pools are
+``(L_draft, num_blocks, block_size, H_draft, D_draft)`` indexed by the
+SAME page-table rows, so block accounting stays the scheduler's one
+allocator.  :func:`truncated_draft` builds the classic self-
+speculative draft — the target checkpoint's first ``n`` layers with
+the shared embedding/head — which needs no second training run and
+keeps proposals correlated with the target.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.models.generate import (
+    _ln,
+    _stack_layer_params,
+    pin_logits,
+)
+from apex_tpu.models.gpt import GPTConfig
+from apex_tpu.obs import metrics as obs_metrics
+from apex_tpu.obs import spans
+from apex_tpu.ops.rope import rope_tables
+from apex_tpu.serve import paged, sampling
+from apex_tpu.serve.engine import (
+    ServeConfig,
+    ServeEngine,
+    _paged_block,
+    chunk_prefill_math,
+)
+from apex_tpu.serve.paged import TRASH_BLOCK
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecConfig:
+    """Speculative-decoding knobs.  ``k`` proposals per round: each
+    verify round emits between 1 (immediate rejection — the baseline
+    rate) and ``k + 1`` (all accepted + the bonus draw) tokens per
+    active slot, so tokens/step scales with the draft's acceptance
+    rate and never drops below the non-speculative engine's."""
+
+    k: int = 4
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError(f"k={self.k}; speculative decoding needs "
+                             f">= 1 draft proposal per round")
+
+
+def truncated_draft(params, cfg: GPTConfig, num_layers: int):
+    """``(draft_params, draft_cfg)``: the target checkpoint's first
+    ``num_layers`` transformer blocks with the SHARED embedding,
+    final norm, and lm head — the classic self-speculative draft
+    (layer-skip): no second checkpoint, free vocabulary agreement,
+    and proposals stay correlated with the target because they share
+    most of its weights."""
+    if not 1 <= num_layers < cfg.num_layers:
+        raise ValueError(
+            f"truncated draft needs 1 <= num_layers < {cfg.num_layers}; "
+            f"got {num_layers}")
+    stacked = _stack_layer_params(params, cfg.num_layers)
+    head = jax.tree.map(lambda x: x[:num_layers], stacked)
+    draft = {k: v for k, v in params.items()
+             if not k.startswith("block_") and k != "layers"}
+    draft["layers"] = {"block": head}
+    return draft, dataclasses.replace(cfg, num_layers=num_layers)
+
+
+class SpecEngine(ServeEngine):
+    """The serve engine with speculative decoding: same scheduler,
+    same paged pools, same submit/run front door — ``step()`` runs one
+    draft round + one verify round instead of one decode step.
+
+    >>> draft_p, draft_cfg = truncated_draft(params, cfg, 1)
+    >>> eng = SpecEngine(params, cfg, ServeConfig(), draft_p, draft_cfg,
+    ...                  SpecConfig(k=4))
+    >>> eng.submit(Request("a", prompt_ids, max_new_tokens=16))
+    >>> outputs = eng.run()
+
+    The base engine's single-token decode step still exists (it is the
+    graph-lint ``serve_step`` lane's program) but is never dispatched;
+    ``ServeConfig.aot_cache`` is therefore forced off for the base
+    engine so a fleet-wide ``APEX_TPU_AOT_CACHE`` cannot make startup
+    eagerly compile+export an executable nobody runs (the prefill
+    worker plays the same trick) — the draft/verify steps' own AOT
+    entries are a follow-up, not an accident of inheriting the wrong
+    program's cache key."""
+
+    def __init__(self, params, cfg: GPTConfig, serve_cfg: ServeConfig,
+                 draft_params, draft_cfg: GPTConfig,
+                 spec_cfg: Optional[SpecConfig] = None,
+                 registry: Optional[obs_metrics.Registry] = None,
+                 placement: Optional[Any] = None):
+        if draft_cfg.vocab_size != cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab {draft_cfg.vocab_size} != target vocab "
+                f"{cfg.vocab_size}: proposals would not be token ids "
+                f"of the target's vocabulary")
+        super().__init__(params, cfg,
+                         dataclasses.replace(serve_cfg, aot_cache=False),
+                         registry=registry, placement=placement)
+        self.spec = spec_cfg or SpecConfig()
+        self.dcfg = draft_cfg
+        self.dstacked = _stack_layer_params(draft_params,
+                                            draft_cfg.num_layers)
+        self.dtop = {k: v for k, v in draft_params.items()
+                     if not k.startswith("block_") and k != "layers"}
+        d_head = draft_cfg.hidden_size // draft_cfg.num_heads
+        dtype = self.dtop["tok_emb"]["embedding"].dtype
+        # the draft cache stays DENSE even under an int8 target cache:
+        # the draft only produces proposals (guesses), so its cache
+        # precision buys acceptance rate, not correctness — and the
+        # draft is small, so its bytes are not the regime's bottleneck
+        dkc, dvc = paged.make_pools(
+            draft_cfg.num_layers, serve_cfg.num_blocks,
+            serve_cfg.block_size, draft_cfg.num_heads, d_head, dtype)
+        self.dcarry = {"kc": dkc, "vc": dvc}
+        if placement is not None:
+            from apex_tpu.serve.transfer import place_tree
+            self.dtop = place_tree(self.dtop, placement)
+            self.dstacked = place_tree(self.dstacked, placement)
+            self.dcarry = place_tree(self.dcarry, placement)
+        self.trace_counts.update(draft=0, verify=0, draft_prefill=0)
+        self._draft_step = jax.jit(self._draft_body,
+                                   donate_argnums=(2,))
+        self._verify_step = jax.jit(self._verify_body,
+                                    donate_argnums=(2,))
+        self._draft_prefill = jax.jit(self._draft_prefill_body,
+                                      donate_argnums=(2, 3))
+        # -- speculative telemetry (apex_tpu.obs): host numbers from
+        # the (S,) n_emit fetch the host loop needs anyway, recorded
+        # at the existing step boundary — lag-resolved like every
+        # other serve metric, zero added host syncs
+        self._m_rounds = self.metrics.counter(
+            "serve_spec_rounds_total",
+            "draft+verify speculative rounds dispatched")
+        self._m_draft_steps = self.metrics.counter(
+            "serve_spec_draft_steps_total",
+            "draft single-token steps (k + 1 per round inside the one "
+            "compiled program: k proposals + the cache-fill step for "
+            "the last proposal's KV)")
+        self._m_proposed = self.metrics.counter(
+            "serve_spec_proposed_total",
+            "draft tokens proposed (k x active slots per round)")
+        self._m_accepted = self.metrics.counter(
+            "serve_spec_accepted_total",
+            "draft tokens the target's own draws confirmed")
+        self._m_accept_rate = self.metrics.gauge(
+            "serve_spec_acceptance_rate",
+            "accepted / proposed over the engine's whole history "
+            "(tokens per verify round = 1 + k x this)")
+
+    # -- compiled bodies ----------------------------------------------
+
+    def _draft_body(self, dtop, dstacked, dcarry, keys, tokens, lengths,
+                    active, page_table, temp, top_k, top_p):
+        """ONE compiled program proposing ``k`` tokens per slot: a
+        ``lax.scan`` of ``k + 1`` single-token paged decode steps of
+        the DRAFT model over the draft pools (same page tables, same
+        masks as the target's step).  The extra step exists for its
+        CACHE WRITE alone: a fully-accepted round advances the slot
+        to ``L + k + 1``, so the draft cache must hold position
+        ``L + k`` (the last proposal's KV) — otherwise every
+        all-accept round leaves a permanent never-overwritten hole
+        the draft attends zeros through for the rest of the stream;
+        the extra step's sampled token is discarded.  Writes past
+        the slot's context reach route to the trash block (``pos <
+        m`` joins the active mask — at the end of a budget the
+        clip+modulo coordinates would otherwise WRAP onto live
+        positions).  Proposals are drawn through the
+        same fused epilogue with the slot's REAL key ladder — the
+        keys the verifier will draw with — so a draft that models the
+        target well reproduces the target's sampled draws too, which
+        is what acceptance measures.  The ladder is recomputed by the
+        verifier; the slot chain itself only advances per EMITTED
+        token, so draft usage costs no chain positions."""
+        self.trace_counts["draft"] += 1
+        with spans.span("serve/spec_draft", registry=self.metrics):
+            c = self.dcfg
+            bs = self.scfg.block_size
+            head_dim = c.hidden_size // c.num_heads
+            scale = 1.0 / float(head_dim) ** 0.5
+            m = self.scfg.max_blocks_per_slot * bs
+            kc, vc = dcarry["kc"], dcarry["vc"]
+
+            def one_step(carry, i):
+                tok, keys, kc, vc = carry
+                pos = lengths + i                          # (S,)
+                x = dtop["tok_emb"]["embedding"][tok][:, None]
+                cos, sin = rope_tables(pos[:, None], head_dim,
+                                       c.rope_theta)
+                blocks, offs = paged.token_write_coords(
+                    pos, page_table, bs, active & (pos < m))
+                valid = ((jnp.arange(m)[None, :] <= pos[:, None])
+                         & active[:, None])[:, None, :]
+
+                def layer(lcarry, inputs):
+                    x, kc, vc = lcarry
+                    p_l, layer_i = inputs
+                    x, kc, vc, _ks, _vs, _err = _paged_block(
+                        x, p_l, c, kc, vc, layer_i, cos, sin, blocks,
+                        offs, page_table, valid, scale)
+                    return (x, kc, vc), None
+
+                (x, kc, vc), _ = jax.lax.scan(
+                    layer, (x, kc, vc),
+                    (dstacked, jnp.arange(c.num_layers)))
+                x = _ln(x[:, -1:], dtop["ln_f"], c.layer_norm_eps)
+                logits = pin_logits(x[:, 0] @ dtop["lm_head"]["kernel"])
+                nxt, keys = sampling.sample_tokens(logits, keys, temp,
+                                                   top_k, top_p)
+                nxt = jnp.where(active, nxt, tok)
+                return (nxt, keys, kc, vc), nxt
+
+            (_, _, kc, vc), proposals = jax.lax.scan(
+                one_step, (tokens, keys, kc, vc),
+                jnp.arange(self.spec.k + 1))
+            # step k's token is discarded (it ran for the cache write
+            # at position L+k); (k, S) -> (S, k)
+            return {"kc": kc, "vc": vc}, \
+                jnp.moveaxis(proposals[:self.spec.k], 0, 1)
+
+    def _verify_body(self, top, stacked, carry, proposals, tokens,
+                     lengths, active, page_table, temp, top_k, top_p):
+        """The ``b×(k+1)`` verifier — ONE compiled step: feed every
+        slot ``[last_tok, d_1..d_k]`` at positions ``L..L+k`` through
+        the chunked multi-token cached path (KV written for all rows,
+        causal-vs-cache mask per row), draw the target's token at
+        every position with the slot's key ladder, and accept the
+        longest proposal prefix the draws confirm.  Returns ``(carry',
+        candidates (S, k+1), n_emit (S,))``: the host emits
+        ``candidates[s, :n_emit[s]]`` — accepted proposals plus the
+        target's own draw at the first rejection (or the bonus draw
+        when everything was accepted)."""
+        self.trace_counts["verify"] += 1
+        with spans.span("serve/spec_verify", registry=self.metrics):
+            c = self.cfg
+            bs = self.scfg.block_size
+            mb = self.scfg.max_blocks_per_slot
+            k = self.spec.k
+            kc, vc, keys = carry["kc"], carry["vc"], carry["keys"]
+            ks, vs = carry.get("ks"), carry.get("vs")
+            head_dim = c.hidden_size // c.num_heads
+            scale = 1.0 / float(head_dim) ** 0.5
+            s_ = tokens.shape[0]
+            m = mb * bs
+
+            q_tokens = jnp.concatenate([tokens[:, None], proposals],
+                                       axis=1)              # (S, k+1)
+            positions = lengths[:, None] + jnp.arange(k + 1)  # (S, k+1)
+            x = top["tok_emb"]["embedding"][q_tokens]       # (S,k+1,E)
+            cos, sin = rope_tables(positions, head_dim, c.rope_theta)
+            flat_pos = positions.reshape(-1)                # (S*(k+1),)
+            rows = jnp.repeat(jnp.arange(s_), k + 1)
+            blocks = page_table[rows, jnp.clip(flat_pos // bs, 0,
+                                               mb - 1)]
+            # rows past the slot's context reach write to TRASH: at
+            # the end of a request's budget ``L + k`` can exceed the
+            # last allocated position, and the clip+modulo coordinate
+            # would WRAP onto a live position — silently corrupting
+            # history the in-range rows attend to in this very step
+            # (their writes land first, reads gather after).  Those
+            # overflow rows' own draws are garbage but can never be
+            # emitted: the budget cap retires the slot before them.
+            blocks = jnp.where(jnp.repeat(active, k + 1)
+                               & (flat_pos < m), blocks, TRASH_BLOCK)
+            offs = flat_pos % bs
+            # row i attends to cache positions <= its own global
+            # position (history + causal-within-block, exactly the
+            # chunked-prefill mask); inactive lanes mask out
+            valid = (jnp.arange(m)[None, None, :]
+                     <= positions[:, :, None]) \
+                & active[:, None, None]                     # (S,k+1,M)
+
+            def layer(lcarry, inputs):
+                x, kc, vc, ks, vs = lcarry
+                p_l, layer_i = inputs
+                x, kc, vc, ks, vs, _err = _paged_block(
+                    x, p_l, c, kc, vc, layer_i, cos, sin, blocks, offs,
+                    page_table, valid, scale, ks=ks, vs=vs)
+                return (x, kc, vc, ks, vs), None
+
+            (x, kc, vc, ks, vs), _ = jax.lax.scan(
+                layer, (x, kc, vc, ks, vs),
+                (stacked, jnp.arange(c.num_layers)))
+            x = _ln(x, top["ln_f"], c.layer_norm_eps)       # (S,k+1,E)
+            logits = pin_logits(
+                x @ top["lm_head"]["kernel"])               # (S,k+1,V)
+
+            # the target's draw at every position, one (S, V) row at a
+            # time through the SAME fused epilogue the baseline step
+            # samples with (same program shape per row, same key
+            # ladder -> bitwise the draws the non-spec engine makes)
+            def draw(keys, logits_row):
+                toks, nk = sampling.sample_tokens(logits_row, keys,
+                                                  temp, top_k, top_p)
+                return nk, (toks, nk)
+
+            _, (cand, key_ladder) = jax.lax.scan(
+                draw, keys, jnp.moveaxis(logits, 1, 0))
+            # cand (k+1, S): cand[i] = target token at position L+i+1;
+            # accepted prefix = proposals the draws confirm
+            matches = cand[:k] == jnp.moveaxis(proposals, 0, 1)  # (k,S)
+            accepted = jnp.cumprod(
+                matches.astype(jnp.int32), axis=0).sum(0)   # (S,) = j
+            n_emit = jnp.where(active, accepted + 1, 0)
+            # slot key after its LAST emitted draw: ladder[j] is the
+            # key state after drawing cand[j] = the (j+1)-th emission
+            new_keys = jnp.take_along_axis(
+                key_ladder, accepted[None, :, None], axis=0)[0]
+            new_keys = jnp.where(active[:, None], new_keys, keys)
+            out = {"kc": kc, "vc": vc, "keys": new_keys}
+            if ks is not None:
+                out["ks"], out["vs"] = ks, vs
+            return out, jnp.moveaxis(cand, 0, 1), n_emit
+
+    def _draft_prefill_body(self, dtop, dstacked, kc, vc, table_row,
+                            chunk_ids, start, n_valid):
+        """The draft's prompt prefill: one ``(1, prefill_chunk)``
+        chunk written through the slot's page table into the DRAFT
+        pools — the SAME chunked-prefill math as the engine's chunk
+        (:func:`apex_tpu.serve.engine.chunk_prefill_math`, one copy
+        of the coordinate/mask discipline), just the draft model over
+        dense pools; the logits are discarded (only the KV is needed
+        so the first draft proposal attends to the prompt) and XLA
+        dead-code-eliminates the head matmul."""
+        self.trace_counts["draft_prefill"] += 1
+        with spans.span("serve/spec_draft_prefill",
+                        registry=self.metrics):
+            kc, vc, _ks, _vs, _logits, _err = chunk_prefill_math(
+                self.dcfg, self.scfg.block_size,
+                self.scfg.max_blocks_per_slot, dtop, dstacked, kc, vc,
+                None, None, table_row, chunk_ids, start, n_valid)
+            return kc, vc
+
+    # -- host loop -----------------------------------------------------
+
+    def _run_prefill(self, slot, req) -> None:
+        """Admission: prefill the DRAFT pools over the same prompt
+        chunks, then the target prefill + first-token sample exactly
+        as the base engine does (continuations — preemption resumes,
+        replica-kill reroutes — ride the same path, so the draft
+        cache is rebuilt wherever the target's is)."""
+        cpc = self.scfg.prefill_chunk
+        prompt = np.asarray(req.prompt, np.int32)
+        n = len(prompt)
+        padded = np.zeros((-(-n // cpc)) * cpc, np.int32)
+        padded[:n] = prompt
+        table_row = jnp.asarray(self.sched.page_table[slot])
+        dkc, dvc = self.dcarry["kc"], self.dcarry["vc"]
+        for j in range(0, len(padded), cpc):
+            dkc, dvc = self._draft_prefill(
+                self.dtop, self.dstacked, dkc, dvc, table_row,
+                jnp.asarray(padded[None, j:j + cpc]),
+                jnp.int32(j), jnp.int32(min(cpc, n - j)))
+        self.dcarry = {"kc": dkc, "vc": dvc}
+        super()._run_prefill(slot, req)
+
+    def step(self) -> Dict[str, np.ndarray]:
+        """One speculative step boundary: admit/evict, ONE draft
+        round (k proposals per slot), ONE verify round, then emit
+        1..k+1 tokens per active slot through the scheduler's normal
+        per-token bookkeeping (budget/EOS checked per token, so a
+        mid-block finish retires exactly like the baseline)."""
+        self._admit_and_evict()
+        sched = self.sched
+        if not sched.active.any():
+            return {}
+        t0 = time.perf_counter()
+        args = (jnp.asarray(sched.last_tok), jnp.asarray(sched.lengths),
+                jnp.asarray(sched.active), jnp.asarray(sched.page_table),
+                jnp.asarray(sched.temperature), jnp.asarray(sched.top_k),
+                jnp.asarray(sched.top_p))
+        self.dcarry, proposals = self._draft_step(
+            self.dtop, self.dstacked, self.dcarry,
+            self.carry["keys"], *args)
+        self.carry, cand, n_emit = self._verify_step(
+            self.top, self.stacked, self.carry, proposals, *args)
+        cand = np.asarray(cand)
+        n_emit = np.asarray(n_emit)
+        self._m_step_s.observe(time.perf_counter() - t0)
+        n_act = int(sched.active.sum())
+        k = self.spec.k
+        self._m_rounds.inc()
+        self._m_draft_steps.inc(k + 1)
+        self._m_proposed.inc(k * n_act)
+        self._m_accepted.inc(int((n_emit - 1)[n_emit > 0].sum()))
+        if self._m_proposed.value:
+            self._m_accept_rate.set(
+                self._m_accepted.value / self._m_proposed.value)
+        finished: Dict[str, np.ndarray] = {}
+        emitted = 0
+        for slot in range(sched.num_slots):
+            if not sched.active[slot]:
+                continue
+            for t in range(int(n_emit[slot])):
+                emitted += 1
+                if sched.record_token(slot, int(cand[slot, t])):
+                    uid, out = sched.retire(slot)
+                    finished[uid] = out
+                    break
+        self._m_tokens.inc(emitted)
+        self._outputs.update(finished)
+        self.metrics.tick()
+        return finished

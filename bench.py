@@ -680,6 +680,114 @@ def bench_serve(warmup: int, iters: int, peak: float,
             "ab_ok": bool(tail_ok)}
 
 
+def bench_serve_spec(warmup: int, iters: int, peak: float,
+                     num_slots: int = 8, prefill: int = 512,
+                     new_tokens: int = 128, spec_k: int = 4,
+                     draft_layers: int = 3, tiny: bool = False):
+    """Speculative-vs-baseline serve A/B at EQUAL work
+    (:class:`apex_tpu.serve.SpecEngine` vs
+    :class:`~apex_tpu.serve.ServeEngine`): the SAME mixed-length
+    greedy request stream served by the plain one-token-per-step
+    engine and by the speculative engine (truncated layer-skip draft
+    proposing ``spec_k`` tokens per round, the target verifying the
+    whole block in one b×(k+1) step).
+
+    The headline number is the speculative arm's ``tok_s``; the gate
+    (``ab_ok``) is the latency win in machine-checked form —
+    **tokens per decode dispatch strictly greater with speculation
+    on** (every accepted token saves a full HBM sweep of params +
+    KV, which is what converts the int8-KV bandwidth headroom into
+    latency) — plus ``retraces == 1`` on BOTH arms (the speculation
+    loop must not have broken the static-shape contract).  Latency
+    percentiles come from each engine's own
+    ``serve_decode_step_seconds`` histogram, like every serve
+    config.
+
+    Unlike the other serve configs, the model is BRIEFLY TRAINED
+    (:func:`apex_tpu.models.gpt.train_toy_lm` — the ONE recipe the
+    scenario tool and the spec tests share) and the prompts come
+    from its training stream: acceptance rate — the entire
+    speculative win — is a statement about how well the draft
+    predicts the target, and a random-init model's near-uniform
+    logits make it structurally ~1/vocab (the gate would fail by
+    construction, measuring nothing).  The scenario-matrix artifact
+    (``SCENARIO_r*.json``) carries the full per-scenario grid; this
+    config is the chip-round headline cell."""
+    del peak, warmup, iters
+    import numpy as np
+
+    from apex_tpu.models.gpt import gpt_small_tpu, gpt_tiny, \
+        train_toy_lm
+    from apex_tpu.obs.metrics import Registry
+    from apex_tpu.serve import (Request, ServeConfig, ServeEngine,
+                                SpecConfig, SpecEngine, truncated_draft)
+
+    if tiny:
+        num_slots, prefill, new_tokens, spec_k, draft_layers = \
+            2, 16, 8, 2, 1
+    cfg, params, ids = train_toy_lm(
+        gpt_tiny() if tiny else gpt_small_tpu())
+    draft_layers = min(draft_layers, cfg.num_layers - 1)
+    dp, dcfg = truncated_draft(params, cfg, draft_layers)
+
+    block = 16 if not tiny else 4
+    mb = -(-(prefill + new_tokens) // block)
+    scfg = ServeConfig(
+        num_slots=num_slots, block_size=block,
+        num_blocks=num_slots * mb + 1, max_blocks_per_slot=mb,
+        prefill_chunk=min(prefill, 128 if not tiny else 8))
+    ids_np = np.asarray(ids, np.int32)
+
+    def make_reqs(tag):
+        reqs = []
+        for i in range(num_slots * 2):
+            plen = max(2, int(prefill * (0.5 + 0.5 * (i % 2))))
+            row = ids_np[i % ids_np.shape[0]]
+            prompt = np.asarray(
+                [row[j % row.shape[0]] for j in range(plen)], np.int32)
+            reqs.append(Request(uid=f"{tag}{i}", prompt=prompt,
+                                max_new_tokens=new_tokens))
+        return reqs
+
+    def drive(eng, tag):
+        hist = eng.metrics.histogram("serve_decode_step_seconds")
+        toks = eng.metrics.counter("serve_tokens_total")
+        for r in make_reqs(tag):
+            eng.submit(r)
+        eng.step()                   # admission + compile + 1st step
+        mark = hist.state()
+        tok0 = toks.value
+        t0 = time.perf_counter()
+        while not eng.sched.idle():
+            eng.step()
+        wall = time.perf_counter() - t0
+        steps = hist.count - mark[2]
+        produced = toks.value - tok0
+        p50 = hist.quantile(0.5, since=mark) * 1e3 if steps else 0.0
+        p99 = hist.quantile(0.99, since=mark) * 1e3 if steps else 0.0
+        return {"tok_s": round(produced / wall, 2) if wall else 0.0,
+                "p50_ms": round(p50, 3), "p99_ms": round(p99, 3),
+                "steps": int(steps),
+                "tokens_per_step": round(produced / max(steps, 1), 4),
+                "retraces": max(eng.trace_counts.values())}
+
+    base = drive(ServeEngine(params, cfg, scfg, registry=Registry()),
+                 "b")
+    eng = SpecEngine(params, cfg, scfg, dp, dcfg,
+                     SpecConfig(k=spec_k), registry=Registry())
+    spec = drive(eng, "s")
+    spec["acceptance_rate"] = round(float(
+        eng.metrics.gauge("serve_spec_acceptance_rate").value), 4)
+    ab_ok = spec["tokens_per_step"] > base["tokens_per_step"] \
+        and base["retraces"] == 1 and spec["retraces"] == 1
+    return {"tok_s": spec["tok_s"], "batch": num_slots,
+            "prefill": prefill, "new_tokens": new_tokens,
+            "spec_k": spec_k, "draft_layers": draft_layers,
+            "p50_ms": spec["p50_ms"], "p99_ms": spec["p99_ms"],
+            "baseline": base, "spec": spec,
+            "ab_ok": bool(ab_ok)}
+
+
 def _merged_decode_quantile(pairs, q: float) -> float:
     """Fleet-level decode-step quantile: union the replicas' own
     ``serve_decode_step_seconds`` windows (same fixed bucket ladder)
@@ -1537,6 +1645,16 @@ def main(argv=None):
         record("gpt_small_tpu_serve_c8", bench_serve, optional=True,
                warmup=1, iters=1, num_slots=8, prefill=512,
                new_tokens=128, tiny=False)
+        # speculative decoding vs the plain engine on the SAME c8
+        # stream (truncated layer-skip draft, k=4): gated on tokens
+        # per decode dispatch strictly greater with spec on +
+        # retraces==1 both arms — the latency-win claim of
+        # apex_tpu.serve.spec as a bench gate (the full scenario grid
+        # is SCENARIO_r*.json via tools/serve_scenarios.py)
+        record("gpt_small_tpu_serve_spec_c8", bench_serve_spec,
+               optional=True, warmup=1, iters=1, num_slots=8,
+               prefill=512, new_tokens=128, spec_k=4, draft_layers=3,
+               tiny=False)
         # disaggregated prefill/decode fleet vs the monolithic engine
         # at EQUAL resources and the same c16 request stream: prefill
         # on its own mesh slice, 2 decode replicas on disjoint slices,

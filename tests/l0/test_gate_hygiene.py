@@ -597,3 +597,159 @@ def test_real_committed_convergence_artifacts_validate():
     assert len(arts) >= 5
     for p in arts:
         assert mod.validate_convergence_file(str(p)) == [], p.name
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 12: SCENARIO_r*.json — the serve scenario matrix is gate memory
+# ---------------------------------------------------------------------------
+
+def _valid_scenario():
+    def cell(spec, tps):
+        # decode_steps chosen so tokens_per_step IS tokens/steps (the
+        # schema re-derives it — a free-floating number is rejected)
+        c = {"config": {"context": 128, "new_tokens": 16,
+                        "num_slots": 2, "arrival": "steady",
+                        "sampling": "greedy", "kv8": False,
+                        "spec": spec, "churn": False},
+             "tok_s": 800.0, "p50_ms": 2.0, "p99_ms": 4.0,
+             "decode_steps": int(round(60 / tps)), "decode_tokens": 60,
+             "tokens_per_step": tps, "retraces": 1, "preemptions": 0,
+             "gate": {"tail_ok": True, "retrace_ok": True, "ok": True}}
+        if spec:
+            c["acceptance_rate"] = 0.8
+        return c
+
+    cells = {}
+    for i in range(5):
+        cells[f"c{i}"] = cell(False, 2.0)
+        cells[f"c{i}_spec"] = cell(True, 6.0)
+    return {
+        "round": 1, "platform": "cpu", "model": "gpt_tiny",
+        "gate_k": 20.0, "cells": cells,
+        "ab": [{"on": f"c{i}_spec", "off": f"c{i}",
+                "tokens_per_step_on": 6.0, "tokens_per_step_off": 2.0,
+                "spec_wins": True, "gated": i == 0}
+               for i in range(5)],
+        "gate": {"cells_ok": True, "ab_ok": True, "ok": True},
+    }
+
+
+def test_committed_scenario_validated_against_schema(tmp_repo):
+    _analysis_module(tmp_repo, "scenario")
+    (tmp_repo / "SCENARIO_r07_bad.json").write_text('{"round": 7}')
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "bad scenario")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("SCENARIO_r07_bad.json" in p
+               for p in verdict["invalid_scenarios"])
+    assert gate_hygiene.main(["--repo", str(tmp_repo)]) == 1
+
+
+def test_scenario_contradictory_cell_gate_fails_hygiene(tmp_repo):
+    """A cell's tail verdict must be derivable from its own numbers:
+    tail_ok over a p99 beyond K x p50 is a lie the schema rejects."""
+    _analysis_module(tmp_repo, "scenario")
+    doc = _valid_scenario()
+    doc["cells"]["c0"]["p99_ms"] = 999.0   # >> 20 x p50, gate says ok
+    (tmp_repo / "SCENARIO_r08_lie.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "contradictory cell")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert any("CONTRADICTORY" in p and "tail_ok" in p
+               for p in verdict["invalid_scenarios"])
+
+
+def test_scenario_ab_must_cite_real_numbers(tmp_repo):
+    """An A/B row's tokens-per-step must MATCH the cells it cites and
+    its spec_wins must derive from them — a won A/B over a lost pair
+    is schema-invalid either way."""
+    _analysis_module(tmp_repo, "scenario")
+    doc = _valid_scenario()
+    doc["ab"][0]["tokens_per_step_on"] = 1.0   # real cell says 6.0
+    (tmp_repo / "SCENARIO_r09_cite.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "mismatched ab citation")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert any("does not match" in p
+               for p in verdict["invalid_scenarios"])
+    doc = _valid_scenario()
+    doc["ab"][0].update(tokens_per_step_on=1.0,
+                        tokens_per_step_off=2.0)
+    doc["cells"]["c0_spec"]["tokens_per_step"] = 1.0  # spec LOST
+    doc["cells"]["c0_spec"]["decode_steps"] = 60     # 60/60 = 1.0
+    (tmp_repo / "SCENARIO_r09_cite.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "lost ab claims a win")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert any("spec_wins" in p for p in verdict["invalid_scenarios"])
+
+
+def test_scenario_tokens_per_step_must_derive_from_counts(tmp_repo):
+    """The A/B chain's anchor: a cell's tokens_per_step must BE its
+    decode_tokens/decode_steps — a fabricated spec win that edited
+    only the headline number (and the ab row citing it) is rejected
+    by re-derivation, not trusted for matching itself."""
+    _analysis_module(tmp_repo, "scenario")
+    doc = _valid_scenario()
+    doc["cells"]["c0_spec"]["tokens_per_step"] = 9.0
+    doc["ab"][0]["tokens_per_step_on"] = 9.0     # cites "the cell"
+    (tmp_repo / "SCENARIO_r13_fab.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "fabricated tokens_per_step")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert any("CONTRADICTORY record" in p and "tokens_per_step" in p
+               for p in verdict["invalid_scenarios"])
+
+
+def test_scenario_too_few_cells_fails_hygiene(tmp_repo):
+    """The coverage bar: a committed scenario round under MIN_CELLS
+    cells is not a matrix."""
+    _analysis_module(tmp_repo, "scenario")
+    doc = _valid_scenario()
+    doc["cells"] = {k: doc["cells"][k] for k in ("c0", "c0_spec")}
+    doc["ab"] = doc["ab"][:1]
+    (tmp_repo / "SCENARIO_r10_thin.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "thin scenario round")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert any("MATRIX" in p or "matrix" in p
+               for p in verdict["invalid_scenarios"])
+
+
+def test_scenario_churn_cell_must_preempt(tmp_repo):
+    _analysis_module(tmp_repo, "scenario")
+    doc = _valid_scenario()
+    doc["cells"]["c1"]["config"]["churn"] = True   # preemptions stays 0
+    (tmp_repo / "SCENARIO_r11_churn.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "churnless churn cell")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert any("churned nothing" in p
+               for p in verdict["invalid_scenarios"])
+
+
+def test_valid_scenario_passes_and_untracked_fails(tmp_repo):
+    _analysis_module(tmp_repo, "scenario")
+    (tmp_repo / "SCENARIO_r12_ok.json").write_text(
+        json.dumps(_valid_scenario()))
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert verdict["untracked"] == ["SCENARIO_r12_ok.json"]
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "good scenario")
+    assert gate_hygiene.check(str(tmp_repo))["ok"]
+
+
+def test_repo_scenario_validates():
+    """The committed SCENARIO artifact is the schema's reference
+    instance; it must stay valid — and its gate must HOLD (>= 10
+    cells, every cell gate green, every gated spec-vs-baseline A/B
+    won: the 'handles many scenarios' + speculative-latency-win
+    acceptance bars ride this assertion)."""
+    assert gate_hygiene._validate_scenarios(str(REPO)) == []
+    arts = sorted(REPO.glob("SCENARIO_r*.json"))
+    assert arts, "the scenario gate artifact must be committed"
+    doc = json.loads(arts[-1].read_text())
+    assert len(doc["cells"]) >= 10
+    assert doc["gate"]["ok"] is True

@@ -574,7 +574,11 @@ ENTRY_POINTS = ([_entry_param(f, o)
                    # rides the slow lane (tier-1 budget)
                    _entry_param("serve_prefill", None),
                    pytest.param("serve_decode", None, id="serve_decode",
-                                marks=(pytest.mark.slow,))])
+                                marks=(pytest.mark.slow,)),
+                   # the speculative-decoding verifier: a NEW program
+                   # class (b×(k+1) multi-token verify + on-device
+                   # acceptance), so it rides tier-1 like serve_step
+                   _entry_param("serve_verify", None)])
 
 
 @pytest.mark.parametrize("name,opt_level", ENTRY_POINTS)
@@ -583,6 +587,8 @@ def test_every_entry_point_lints_clean(name, opt_level):
     if opt_level is None:
         if name in graph_lint.SERVE_PREFILL_LANES:
             lint = graph_lint.lint_serve_prefill
+        elif name in graph_lint.SERVE_VERIFY_LANES:
+            lint = graph_lint.lint_serve_verify
         elif name in graph_lint.SERVE_LANES:
             lint = graph_lint.lint_serve
         else:

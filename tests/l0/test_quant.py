@@ -306,27 +306,11 @@ def tiny_lm():
     documented token-match tolerance is a statement about (the
     pysrc-trained rate is the convergence artifact's
     ``int8_kv_decode`` lane)."""
-    from apex_tpu.models.gpt import lm_loss
+    from apex_tpu.models.gpt import train_toy_lm
 
-    cfg = gpt_tiny()
-    model = GPTModel(cfg)
-    period = 16
-    ids = (jnp.arange(8 * 64).reshape(8, 64) * 7) % period
-    params = model.init(jax.random.PRNGKey(8),
-                        ids[:1, :8].astype(jnp.int32))["params"]
-    a = amp.initialize(optimizer=FusedAdam(lr=3e-3), opt_level="O2",
-                       verbosity=0)
-    state = a.init(params)
-
-    def loss_fn(p, xb):
-        logits = model.apply({"params": p}, xb)
-        return lm_loss(logits[:, :-1], xb[:, 1:])
-
-    step = jax.jit(amp.make_train_step(a, loss_fn))
-    for _ in range(50):
-        state, _m = step(state, ids.astype(jnp.int32))
-    prompt = ids[:2, :8].astype(jnp.int32)
-    return cfg, a.model_params(state), prompt
+    cfg, params, ids = train_toy_lm()
+    prompt = jnp.asarray(ids[:2, :8], jnp.int32)
+    return cfg, params, prompt
 
 
 def test_int8_kv_decode_matches_dense_within_tolerance(tiny_lm):

@@ -270,30 +270,40 @@ def test_advance_key_replays_the_sampling_chain():
                                   np.asarray(derived))
 
 
-@pytest.mark.slow
-def test_fleet_matches_mono_engine_on_ulp_adversarial_stream(setup):
-    """The transfer path's EXACT contract: the fleet must equal the
-    monolithic engine bitwise even on streams where the engine itself
-    drifts from solo generate() by a greedy argmax ulp-tie (found
-    during this PR's verification drive: a 19-token prompt where the
-    batched decode step and solo's b1 decode land a 1-ulp tie the
-    other way — pre-existing PR 6 behavior, reproduced at HEAD).
-    Shipping KV between slices must add ZERO numeric drift on top."""
+def test_mono_and_fleet_match_solo_on_ulp_adversarial_stream(setup):
+    """The tie class is DEAD: PR 10's verification drive found a
+    19-token prompt whose batched decode step greedy-diverged from
+    solo ``generate()`` at token 8 with bitwise-identical caches — an
+    exactly-tied bf16 logit pair whose ranking flipped with XLA:CPU
+    fusion context (the lm-head matmul rematerialized per consumer,
+    and ``jnp.argmax``'s tie-break followed whichever copy it fused
+    with).  ``models.generate.pin_logits`` now materializes the
+    logits once per program and ``greedy_argmax`` breaks exact ties
+    by lowest index reassociation-proof, so the mono engine, the
+    fleet, AND solo all agree bitwise on the adversarial stream —
+    asserted as a tier-1 EQUALITY (this was the slow-marked
+    fleet==mono regression test while the divergence lived; the
+    speculative-decoding verifier's greedy token-match gate depends
+    on this class staying dead)."""
     cfg, params, _ = setup
     rng = np.random.RandomState(5)
     for n in (4, 7, 10, 13, 16):          # the draw sequence that
         rng.randint(0, cfg.vocab_size, (n,))   # produced the tie case
     prompt = rng.randint(0, cfg.vocab_size, (19,))
+    solo = _solo(params, cfg, prompt, 9)
     from apex_tpu.serve import ServeEngine
     eng = ServeEngine(params, cfg, SCFG, registry=Registry())
     eng.submit(Request(uid="x", prompt=prompt, max_new_tokens=9))
     mono = eng.run()["x"]
+    np.testing.assert_array_equal(
+        mono, solo, err_msg="mono engine vs solo: the ulp-tie "
+        "divergence class is back (pin_logits/greedy_argmax)")
     router = DisaggRouter(
         params, cfg, SCFG,
         RouterConfig(n_decode_replicas=2, transfer="ship"),
         registry=Registry())
     router.submit(Request(uid="x", prompt=prompt, max_new_tokens=9))
-    np.testing.assert_array_equal(router.run()["x"], mono)
+    np.testing.assert_array_equal(router.run()["x"], solo)
 
 
 # ---------------------------------------------------------------------------

@@ -623,6 +623,21 @@ def test_bench_serve_tiny_cpu():
     assert all(v["retraces"] == 1 for v in levels.values())
 
 
+def test_bench_serve_spec_tiny_cpu():
+    """The speculative serve A/B end-to-end on CPU: the briefly
+    trained model gives the layer-skip draft real margins, the same
+    stream runs through both arms, and the gate — tokens per decode
+    dispatch strictly greater with spec on, retraces == 1 both arms —
+    holds (ab_ok rides gate_exit_code's absolute ab_failures lane
+    like every other sign gate)."""
+    r = bench.bench_serve_spec(warmup=1, iters=1, peak=None, tiny=True)
+    assert r["ab_ok"] is True
+    assert r["spec"]["tokens_per_step"] > r["baseline"]["tokens_per_step"]
+    assert r["spec"]["retraces"] == 1 and r["baseline"]["retraces"] == 1
+    assert r["spec"]["acceptance_rate"] > 0
+    assert r["tok_s"] > 0 and r["p99_ms"] >= r["p50_ms"] > 0
+
+
 def test_merged_decode_quantile_unions_replica_windows():
     """The fleet percentile is the union of the replicas' histogram
     windows through the SAME Histogram interpolation — two replicas

@@ -63,7 +63,14 @@ This check fails (exit 1) when
   drill, and a ``gate`` whose ``p99_ok``/``ok`` AGREE with the
   recorded numbers — a verdict contradicting its own A/B is
   schema-invalid) — the p99 gate of the disaggregated fleet is gate
-  memory like every other floor.
+  memory like every other floor, or
+- a committed ``SCENARIO_r*.json`` does not validate against the
+  serve scenario-matrix schema (``apex_tpu/analysis/scenario.py``:
+  >= 10 cells each carrying config/percentiles and a gate verdict
+  that AGREES with its own numbers, a spec-vs-baseline A/B whose
+  ``spec_wins`` rows agree with the tokens-per-step numbers they
+  cite) — "handles many scenarios" and the speculative-decoding
+  latency win are gate memory, not prose.
 
 It is wired into tier-1 (``tests/l0/test_gate_hygiene.py``), so a round
 cannot go green with dirty gate memory.  Best-effort on the VCS side:
@@ -98,7 +105,7 @@ PATTERNS = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json",
             "PRECLINT_r*.json", "DECODE_DECOMPOSE_r*.json",
             "OBS_r*.json", "DECODE_PROFILE_r*.json",
             "CONVERGENCE_r*.json", "EXPORT_r*.json",
-            "SERVE_DISAGG_r*.json")
+            "SERVE_DISAGG_r*.json", "SCENARIO_r*.json")
 
 #: Round-numbered incident artifacts additionally get schema-validated.
 INCIDENT_PATTERN = "INCIDENT_r*.json"
@@ -124,8 +131,11 @@ CONVERGENCE_PATTERN = "CONVERGENCE_r*.json"
 #: ... and the AOT-export artifacts ...
 EXPORT_PATTERN = "EXPORT_r*.json"
 
-#: ... and the disaggregated-serving gate artifacts.
+#: ... and the disaggregated-serving gate artifacts ...
 SERVE_DISAGG_PATTERN = "SERVE_DISAGG_r*.json"
+
+#: ... and the serve scenario-matrix gate artifacts.
+SCENARIO_PATTERN = "SCENARIO_r*.json"
 
 
 def _load_by_path(repo: str, *rel: str):
@@ -271,6 +281,19 @@ def _validate_serve_disaggs(repo: str) -> "list[str]":
     return problems
 
 
+def _validate_scenarios(repo: str) -> "list[str]":
+    """Schema problems over every present SCENARIO_r*.json, as
+    ``path: problem`` strings (``apex_tpu/analysis/scenario.py``)."""
+    schema = _load_by_path(repo, "apex_tpu", "analysis", "scenario.py")
+    if schema is None:
+        return []
+    problems = []
+    for p in sorted(Path(repo).glob(SCENARIO_PATTERN)):
+        for msg in schema.validate_scenario_file(str(p)):
+            problems.append(f"{p.name}: {msg}")
+    return problems
+
+
 def _git(repo: str, *args: str) -> "str | None":
     """stdout of a git command, or None when git/The repo is unavailable
     (the best-effort contract)."""
@@ -298,7 +321,8 @@ def check(repo: str = str(REPO)) -> dict:
                 "invalid_memlints": [], "invalid_preclints": [],
                 "invalid_decomposes": [], "invalid_obs": [],
                 "invalid_profiles": [], "invalid_convergences": [],
-                "invalid_exports": [], "invalid_serve_disaggs": []}
+                "invalid_exports": [], "invalid_serve_disaggs": [],
+                "invalid_scenarios": []}
     tracked = set(tracked_raw.split())
     missing = [f for f in REQUIRED
                if not (Path(repo) / f).exists() or f not in tracked]
@@ -327,10 +351,12 @@ def check(repo: str = str(REPO)) -> dict:
     invalid_conv = _validate_convergences(repo)
     invalid_exp = _validate_exports(repo)
     invalid_disagg = _validate_serve_disaggs(repo)
+    invalid_scen = _validate_scenarios(repo)
     return {"ok": not (missing or untracked or dirty or invalid
                        or invalid_mem or invalid_prec or invalid_dec
                        or invalid_obs or invalid_prof or invalid_conv
-                       or invalid_exp or invalid_disagg),
+                       or invalid_exp or invalid_disagg
+                       or invalid_scen),
             "missing": missing, "untracked": untracked, "dirty": dirty,
             "invalid_incidents": invalid,
             "invalid_memlints": invalid_mem,
@@ -340,7 +366,8 @@ def check(repo: str = str(REPO)) -> dict:
             "invalid_profiles": invalid_prof,
             "invalid_convergences": invalid_conv,
             "invalid_exports": invalid_exp,
-            "invalid_serve_disaggs": invalid_disagg}
+            "invalid_serve_disaggs": invalid_disagg,
+            "invalid_scenarios": invalid_scen}
 
 
 def main(argv=None) -> int:
@@ -365,7 +392,8 @@ def main(argv=None) -> int:
               f"{verdict.get('invalid_convergences', [])}; invalid "
               f"export records {verdict.get('invalid_exports', [])}; "
               f"invalid serve-disagg records "
-              f"{verdict.get('invalid_serve_disaggs', [])}",
+              f"{verdict.get('invalid_serve_disaggs', [])}; invalid "
+              f"scenario records {verdict.get('invalid_scenarios', [])}",
               file=sys.stderr)
         return 1
     return 0

@@ -163,6 +163,17 @@ SERVE_LANES = {"serve_step": (2, 4, 9, 4),
 #: length is the config's ``prefill_chunk`` (= block_size here).
 SERVE_PREFILL_LANES = {"serve_prefill": (2, 4, 9, 4)}
 
+#: the speculative-decoding verifier (``apex_tpu.serve.spec.
+#: SpecEngine._verify_step``): the b×(k+1) multi-token cached forward
+#: that scores every slot's draft proposals in ONE dispatch, samples
+#: the target's draw at each position with the slot's key ladder, and
+#: returns the accepted counts — the serve engine's third compiled
+#: program class.  Tuple = (num_slots, block_size, num_blocks,
+#: max_blocks_per_slot, k); lints through the same full pass matrix
+#: as the decode step (no host callback / no static scalar on the
+#: speculation loop, donated carry fully aliased).
+SERVE_VERIFY_LANES = {"serve_verify": (2, 4, 9, 4, 3)}
+
 
 def build_train_step(family: str, raw=None, opt_level: str = "O1"):
     """(jitted_step, example_args, properties): the full train step —
@@ -268,6 +279,43 @@ def build_serve_prefill(num_slots: int = 2, block_size: int = 4,
     return eng._prefill_chunk, args, a.properties
 
 
+def build_serve_verify(num_slots: int = 2, block_size: int = 4,
+                       num_blocks: int = 9, max_blocks_per_slot: int = 4,
+                       k: int = 3):
+    """(jitted_verify, args, properties): the speculative-decoding
+    verify step at a tiny config — the target model scoring ``k``
+    draft proposals per slot in one b×(k+1) dispatch (KV written for
+    every fed position through the paged pools, acceptance computed
+    on device, carry donated) — plus the O2 serving policy.  The
+    draft is the target's truncated first layer (the layer-skip
+    self-draft), which shapes the proposal argument without needing a
+    second checkpoint."""
+    from apex_tpu.models.gpt import GPTModel, gpt_tiny
+    from apex_tpu.serve import (ServeConfig, SpecConfig, SpecEngine,
+                                truncated_draft)
+
+    cfg = gpt_tiny()
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    a = amp.initialize(opt_level="O2", verbosity=0)
+    params = a.model_params_from(params)
+    scfg = ServeConfig(num_slots=num_slots, block_size=block_size,
+                       num_blocks=num_blocks,
+                       max_blocks_per_slot=max_blocks_per_slot,
+                       prefill_chunk=block_size)
+    dp, dcfg = truncated_draft(params, cfg, max(1, cfg.num_layers - 1))
+    eng = SpecEngine(params, cfg, scfg, dp, dcfg, SpecConfig(k=k))
+    s = eng.sched
+    args = (eng.top, eng.stacked, eng.carry,
+            jnp.zeros((num_slots, k), jnp.int32),
+            jnp.asarray(s.last_tok), jnp.asarray(s.lengths),
+            jnp.asarray(s.active), jnp.asarray(s.page_table),
+            jnp.asarray(s.temperature), jnp.asarray(s.top_k),
+            jnp.asarray(s.top_p))
+    return eng._verify_step, args, a.properties
+
+
 def _lint_serve_program(lane: str, fn, args, props, passes, compile,
                         memory_budget, _collect):
     passes = tuple(
@@ -310,6 +358,20 @@ def lint_serve_prefill(lane: str, passes=None, compile: bool = True,
         return analysis.Report()
     slots, bs, nb, mb = SERVE_PREFILL_LANES[lane]
     fn, args, props = build_serve_prefill(slots, bs, nb, mb)
+    return _lint_serve_program(lane, fn, args, props, passes, compile,
+                               memory_budget, _collect)
+
+
+def lint_serve_verify(lane: str, passes=None, compile: bool = True,
+                      memory_budget=None, _collect=None):
+    """Lint one speculative-verify lane — the b×(k+1) verifier step
+    the spec engine dispatches once per speculation round, under the
+    same pass matrix as the decode lanes."""
+    if passes is not None and not tuple(p for p in passes
+                                        if p != "policy"):
+        return analysis.Report()
+    slots, bs, nb, mb, k = SERVE_VERIFY_LANES[lane]
+    fn, args, props = build_serve_verify(slots, bs, nb, mb, k)
     return _lint_serve_program(lane, fn, args, props, passes, compile,
                                memory_budget, _collect)
 
@@ -520,6 +582,12 @@ def emit_memlint(path: str, families, memory_budget=None,
         n_errors += len(rep.errors)
         if verbose:
             print(f"--- {lane} ---\n{rep.format()}", file=sys.stderr)
+    for lane in SERVE_VERIFY_LANES:
+        rep = lint_serve_verify(lane, memory_budget=memory_budget,
+                                _collect=lanes)
+        n_errors += len(rep.errors)
+        if verbose:
+            print(f"--- {lane} ---\n{rep.format()}", file=sys.stderr)
 
     calibration = _calibration_audit()
     n_errors += sum(1 for f in calibration if f.severity == "error")
@@ -591,6 +659,11 @@ def emit_preclint(path: str, families, verbose: bool = False) -> int:
         record(lane, ctx)
     for lane, (slots, bs, nb, mb) in SERVE_PREFILL_LANES.items():
         fn, args, props = build_serve_prefill(slots, bs, nb, mb)
+        lowered = analysis.lower_quiet(fn, *args)
+        ctx = analysis.build_context(lowered, compile=False, policy=props)
+        record(lane, ctx)
+    for lane, (slots, bs, nb, mb, k) in SERVE_VERIFY_LANES.items():
+        fn, args, props = build_serve_verify(slots, bs, nb, mb, k)
         lowered = analysis.lower_quiet(fn, *args)
         ctx = analysis.build_context(lowered, compile=False, policy=props)
         record(lane, ctx)
@@ -810,6 +883,10 @@ def main(argv=None) -> int:
                 memory_budget=budget))
         for lane in SERVE_PREFILL_LANES:
             run(lane, lambda ln=lane: lint_serve_prefill(
+                ln, passes=passes, compile=not opts.no_compile,
+                memory_budget=budget))
+        for lane in SERVE_VERIFY_LANES:
+            run(lane, lambda ln=lane: lint_serve_verify(
                 ln, passes=passes, compile=not opts.no_compile,
                 memory_budget=budget))
     if failed:
