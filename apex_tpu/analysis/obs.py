@@ -33,10 +33,27 @@ Document shape::
                 "lanes": {"serve_step": {"host_callbacks": 0,
                                          "static_scalars": 0,
                                          "errors": 0}, ...}},
+      "tracing": {"per_event_us": 1.2,       # optional section (r02+):
+                  "flight_note_us": 1.0,     # request-trace / flight
+                  "events_per_step": 3,      # per-event record cost,
+                  "decode_step_ms": 2.5,     # gated against the
+                  "overhead_pct": 0.2},      # bench-smoke decode step
+                                             # — must be <= 1.0.
+                                             # r02 also records the
+                                             # spec round's denser
+                                             # 2-events/slot lane;
+                                             # overhead_pct is the
+                                             # WORSE lane
       "export": {"metrics": [{"name": ..., "type": "counter", ...}]},
       "note": "..."
     }
-"""
+
+The ``tracing`` section (optional so the pre-tracing r01 stays valid)
+carries the ISSUE-13 bar: the per-event cost of
+:meth:`apex_tpu.obs.reqtrace.RequestTracer.record` times the events a
+decode step records, as a percentage of the measured bench-smoke
+decode step — the request-tracing layer must stay as far off the step
+path as the metrics layer."""
 
 from __future__ import annotations
 
@@ -45,6 +62,10 @@ from typing import List
 
 #: acceptance bar: instrumentation overhead on the normal step path
 OVERHEAD_BUDGET_PCT = 1.0
+
+#: acceptance bar: per-step request-tracing record cost as a fraction
+#: of the bench-smoke decode step (the ISSUE-13 tracing lane)
+TRACING_BUDGET_PCT = 1.0
 
 #: instrument kinds the export may carry
 METRIC_TYPES = ("counter", "gauge", "histogram")
@@ -104,6 +125,30 @@ def validate_obs(doc) -> List[str]:
                         problems.append(
                             f"syncs lane {name!r} has {key}={v} — "
                             f"instrumentation introduced a hazard")
+
+    tr = doc.get("tracing")
+    if tr is not None:                 # optional: r01 predates tracing
+        if not isinstance(tr, dict):
+            problems.append("'tracing' present but not an object")
+        else:
+            for key in ("per_event_us", "flight_note_us",
+                        "decode_step_ms", "overhead_pct"):
+                if not isinstance(tr.get(key), (int, float)) \
+                        or isinstance(tr.get(key), bool):
+                    problems.append(f"tracing missing numeric {key!r}")
+            eps = tr.get("events_per_step")
+            if not (isinstance(eps, int) and not isinstance(eps, bool)
+                    and eps > 0):
+                problems.append(
+                    "tracing missing positive int 'events_per_step'")
+            pct = tr.get("overhead_pct")
+            if isinstance(pct, (int, float)) \
+                    and not isinstance(pct, bool) \
+                    and pct > TRACING_BUDGET_PCT:
+                problems.append(
+                    f"tracing overhead_pct {pct} over the "
+                    f"{TRACING_BUDGET_PCT}% budget — request tracing "
+                    f"must stay off the decode step path")
 
     ex = doc.get("export")
     rows = ex.get("metrics") if isinstance(ex, dict) else None

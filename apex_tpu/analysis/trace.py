@@ -1,0 +1,302 @@
+"""TRACE_r*.json — schema for the committed request-trace artifact.
+
+``tools/trace_report.py`` runs the disaggregated c16 chaos drill with
+request tracing on (:mod:`apex_tpu.obs.reqtrace`) and commits the
+resulting lifecycle document: every request's event list and span
+tree, the fleet engines' own token-counter deltas, the chaos block
+naming the killed replica, and a gate verdict.  Like every other gate
+artifact the document is **contradiction-rejecting** — a trace that
+disagrees with itself is schema-INVALID, so the committed artifact
+cannot rot into a story nobody re-derived:
+
+- **span trees must nest** — every non-root span's interval must sit
+  inside its parent's, parents must precede children, and there is
+  exactly one root;
+- **token accounting must close** — each request's ``tokens`` must
+  equal the sum of its token-carrying events, and the fleet total must
+  equal the engines' own ``serve_tokens_total`` deltas (the trace and
+  the metrics registry are two witnesses of the same stream; when they
+  disagree, one of them is lying);
+- **every reroute must name a killed replica** — a ``reroute`` event
+  citing a replica the chaos block never killed (or a chaos block
+  whose rerouted uids carry no reroute events) is a fabricated
+  recovery story;
+- **the gate must agree with its own numbers** — ``gate.tokens_ok``
+  is re-derived from the accounting above and ``gate.ok`` must be
+  exactly ``bitwise_ok and tokens_ok``.
+
+Event vocabulary and lifecycle shape are pinned to
+:data:`apex_tpu.obs.reqtrace.EVENT_KINDS` (duplicated here because
+this module must stay **stdlib-only** — ``tools/gate_hygiene.py``
+loads it directly by file path, never paying the jax import; a test
+asserts the two tuples are equal).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+#: pinned copy of apex_tpu.obs.reqtrace.EVENT_KINDS (stdlib-only rule;
+#: equality asserted by tests/l0/test_reqtrace.py)
+EVENT_KINDS = (
+    "enqueue", "admit", "prefill_chunk", "kv_ship", "kv_install",
+    "decode_step", "spec_draft", "spec_verify", "preempt", "reroute",
+    "retire",
+)
+
+#: event kinds whose ``tokens`` fields sum to the request's accounting
+TOKEN_KINDS = ("admit", "decode_step", "spec_verify")
+
+
+def _num(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _int(v: Any) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool)
+
+
+def _validate_events(uid: str, events: Any) -> List[str]:
+    problems: List[str] = []
+    if not isinstance(events, list) or not events:
+        return [f"requests[{uid}]: 'events' must be a non-empty list"]
+    last_seq, last_ts = None, None
+    for i, ev in enumerate(events):
+        tag = f"requests[{uid}].events[{i}]"
+        if not isinstance(ev, dict):
+            problems.append(f"{tag}: must be an object")
+            continue
+        if ev.get("kind") not in EVENT_KINDS:
+            problems.append(
+                f"{tag}: kind {ev.get('kind')!r} outside the "
+                f"vocabulary {EVENT_KINDS}")
+        if not (isinstance(ev.get("where"), str)
+                and ev["where"].strip()):
+            problems.append(f"{tag}: missing non-empty str 'where'")
+        seq, ts = ev.get("seq"), ev.get("ts")
+        if not _int(seq):
+            problems.append(f"{tag}: missing int 'seq'")
+        elif last_seq is not None and seq <= last_seq:
+            problems.append(
+                f"{tag}: seq {seq} does not increase past {last_seq}")
+        else:
+            last_seq = seq
+        if not _num(ts):
+            problems.append(f"{tag}: missing numeric 'ts'")
+        elif last_ts is not None and ts < last_ts:
+            problems.append(
+                f"{tag}: ts {ts} precedes its predecessor {last_ts}")
+        else:
+            last_ts = ts
+        if "tokens" in ev and not (_int(ev["tokens"])
+                                   and ev["tokens"] >= 0):
+            problems.append(f"{tag}: 'tokens' must be an int >= 0")
+    if problems:
+        return problems
+    if events[0]["kind"] != "enqueue":
+        problems.append(
+            f"requests[{uid}]: lifecycle must begin with 'enqueue', "
+            f"got {events[0]['kind']!r}")
+    retires = [i for i, e in enumerate(events) if e["kind"] == "retire"]
+    if len(retires) != 1 or retires[0] != len(events) - 1:
+        problems.append(
+            f"requests[{uid}]: lifecycle must end with exactly one "
+            f"'retire' (found at {retires})")
+    return problems
+
+
+def _validate_spans(uid: str, spans: Any) -> List[str]:
+    """Span-tree nesting: one root, parents precede children, child
+    intervals inside parent intervals."""
+    problems: List[str] = []
+    if not isinstance(spans, list) or not spans:
+        return [f"requests[{uid}]: 'spans' must be a non-empty list"]
+    roots = 0
+    for i, sp in enumerate(spans):
+        tag = f"requests[{uid}].spans[{i}]"
+        if not isinstance(sp, dict):
+            problems.append(f"{tag}: must be an object")
+            continue
+        if not (isinstance(sp.get("name"), str) and sp["name"].strip()):
+            problems.append(f"{tag}: missing non-empty str 'name'")
+        t0, t1 = sp.get("t0"), sp.get("t1")
+        if not (_num(t0) and _num(t1) and t0 <= t1):
+            problems.append(f"{tag}: needs numeric t0 <= t1, got "
+                            f"({t0!r}, {t1!r})")
+            continue
+        parent = sp.get("parent")
+        if not _int(parent):
+            problems.append(f"{tag}: missing int 'parent'")
+            continue
+        if parent == -1:
+            roots += 1
+            continue
+        if not 0 <= parent < i:
+            problems.append(
+                f"{tag}: parent {parent} must index an EARLIER span")
+            continue
+        pa = spans[parent]
+        if isinstance(pa, dict) and _num(pa.get("t0")) \
+                and _num(pa.get("t1")) \
+                and not (pa["t0"] <= t0 and t1 <= pa["t1"]):
+            problems.append(
+                f"{tag}: CONTRADICTION — span [{t0}, {t1}] does not "
+                f"nest inside its parent [{pa['t0']}, {pa['t1']}]; "
+                f"span trees must nest")
+    if roots != 1:
+        problems.append(
+            f"requests[{uid}]: spans must carry exactly one root "
+            f"(parent == -1), found {roots}")
+    return problems
+
+
+def validate_trace(doc: Any) -> List[str]:
+    """Problems with one parsed TRACE document (empty = valid)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not a JSON object"]
+    if not _int(doc.get("round")):
+        problems.append("missing/invalid 'round' (int)")
+    if not isinstance(doc.get("platform"), str):
+        problems.append("missing/invalid 'platform' (str)")
+    if not isinstance(doc.get("config"), dict):
+        problems.append("missing/invalid 'config' object")
+
+    reqs = doc.get("requests")
+    if not isinstance(reqs, dict) or not reqs:
+        problems.append("missing/empty 'requests' object")
+        return problems
+
+    token_total = 0
+    reroute_uids = set()
+    reroute_from: Dict[str, List[int]] = {}
+    for uid, rec in reqs.items():
+        if not isinstance(rec, dict):
+            problems.append(f"requests[{uid}]: must be an object")
+            continue
+        if not (isinstance(rec.get("trace_id"), str)
+                and rec["trace_id"].strip()):
+            problems.append(
+                f"requests[{uid}]: missing non-empty 'trace_id'")
+        ev_problems = _validate_events(uid, rec.get("events"))
+        problems.extend(ev_problems)
+        problems.extend(_validate_spans(uid, rec.get("spans")))
+        if ev_problems:
+            continue
+        events = rec["events"]
+        ev_tokens = sum(int(e.get("tokens", 0)) for e in events)
+        if not (_int(rec.get("tokens")) and rec["tokens"] >= 0):
+            problems.append(
+                f"requests[{uid}]: missing int 'tokens' >= 0")
+        elif rec["tokens"] != ev_tokens:
+            problems.append(
+                f"requests[{uid}]: CONTRADICTION — recorded tokens "
+                f"{rec['tokens']} != {ev_tokens} summed over the "
+                f"request's own token-carrying events")
+        token_total += ev_tokens
+        for e in events:
+            if e["kind"] == "reroute":
+                reroute_uids.add(uid)
+                if _int(e.get("from_replica")):
+                    reroute_from.setdefault(uid, []).append(
+                        e["from_replica"])
+                else:
+                    problems.append(
+                        f"requests[{uid}]: reroute event missing int "
+                        f"'from_replica' — every reroute must name "
+                        f"the replica that died")
+
+    # -- engine-counter cross-check (the trace's second witness) -------
+    eng = doc.get("engine")
+    if not isinstance(eng, dict):
+        problems.append("missing/invalid 'engine' object")
+    else:
+        per = eng.get("serve_tokens_total")
+        delta = eng.get("delta_total")
+        if not (isinstance(per, dict) and per
+                and all(_num(v) for v in per.values())):
+            problems.append(
+                "engine missing non-empty numeric "
+                "'serve_tokens_total' per-engine table")
+        if not _int(delta):
+            problems.append("engine missing int 'delta_total'")
+        else:
+            if isinstance(per, dict) and per \
+                    and all(_num(v) for v in per.values()) \
+                    and delta != round(sum(per.values())):
+                problems.append(
+                    f"engine: CONTRADICTION — delta_total {delta} != "
+                    f"{round(sum(per.values()))} summed over its own "
+                    f"per-engine table")
+            if delta != token_total:
+                problems.append(
+                    f"CONTRADICTION — the trace accounts "
+                    f"{token_total} decode tokens but the engines' "
+                    f"serve_tokens_total delta is {delta}; the trace "
+                    f"and the registry are two witnesses of one "
+                    f"stream and must agree")
+
+    # -- chaos / reroute consistency -----------------------------------
+    chaos = doc.get("chaos")
+    if reroute_uids and not isinstance(chaos, dict):
+        problems.append(
+            "requests carry reroute events but the document has no "
+            "'chaos' block naming what was killed")
+    if isinstance(chaos, dict):
+        killed = chaos.get("killed")
+        if not (isinstance(killed, list)
+                and all(_int(k) for k in killed)):
+            problems.append("chaos.killed must be a list of replica "
+                            "ints")
+            killed = []
+        for uid, sources in reroute_from.items():
+            for src in sources:
+                if src not in killed:
+                    problems.append(
+                        f"requests[{uid}]: CONTRADICTION — reroute "
+                        f"names replica {src}, which chaos.killed "
+                        f"{killed} never lost")
+        listed = chaos.get("rerouted")
+        if not (isinstance(listed, list)
+                and all(isinstance(u, str) for u in listed)):
+            problems.append("chaos.rerouted must be a list of uids")
+        elif set(listed) != reroute_uids:
+            problems.append(
+                f"CONTRADICTION — chaos.rerouted {sorted(listed)} != "
+                f"uids with reroute events {sorted(reroute_uids)}")
+
+    # -- gate: must agree with its own numbers -------------------------
+    gate = doc.get("gate")
+    if not isinstance(gate, dict):
+        problems.append("missing/invalid 'gate' object")
+    else:
+        for key in ("bitwise_ok", "tokens_ok", "ok"):
+            if not isinstance(gate.get(key), bool):
+                problems.append(f"gate missing bool {key!r}")
+        if isinstance(gate.get("tokens_ok"), bool) \
+                and isinstance(eng, dict) and _int(eng.get("delta_total")):
+            derived = eng["delta_total"] == token_total
+            if gate["tokens_ok"] != derived:
+                problems.append(
+                    f"gate.tokens_ok {gate['tokens_ok']} contradicts "
+                    f"the re-derived accounting verdict {derived}")
+        if all(isinstance(gate.get(k), bool)
+               for k in ("bitwise_ok", "tokens_ok", "ok")) \
+                and gate["ok"] != (gate["bitwise_ok"]
+                                   and gate["tokens_ok"]):
+            problems.append(
+                "gate.ok must be exactly bitwise_ok and tokens_ok — "
+                "a verdict contradicting its own components is "
+                "schema-invalid")
+    return problems
+
+
+def validate_trace_file(path: str) -> List[str]:
+    """Problems with one TRACE_r*.json file (empty = valid)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable trace JSON: {e}"]
+    return validate_trace(doc)

@@ -16,13 +16,25 @@ instead of re-implemented inside each:
 - :mod:`apex_tpu.obs.xplane` — the xplane / chrome-trace parsing
   library (extracted from ``tools/profile_step.py``; all profile
   tools import it), with device-time aggregation, step markers, and
-  named-bucket attribution for ``tools/profile_decode.py``.
+  named-bucket attribution for ``tools/profile_decode.py``;
+- :mod:`apex_tpu.obs.reqtrace` — per-request lifecycle traces across
+  the serving fleet (request ids minted at router admission, a closed
+  host-side event vocabulary recorded at the existing step
+  boundaries, chrome-trace export, and the committed ``TRACE_r*.json``
+  artifact behind ``apex_tpu/analysis/trace.py``);
+- :mod:`apex_tpu.obs.flight` — the incident flight recorder (a
+  bounded ring of recent events + resolved metric snapshots that
+  incident records ship as their validated ``flight`` field);
+- :mod:`apex_tpu.obs.fleet` — fleet-level registry merging (counter
+  sums, bucket-union histogram quantiles, per-replica gauge tables) —
+  the ONE implementation ``bench.py`` and the serving tools share.
 
 See ``docs/source/observability.rst`` for the metric catalog, the
 lag-resolution contract, and the span naming convention.
 """
 
-from apex_tpu.obs import xplane
+from apex_tpu.obs import fleet, xplane
+from apex_tpu.obs.flight import FlightRecorder
 from apex_tpu.obs.metrics import (
     Counter,
     Gauge,
@@ -35,11 +47,13 @@ from apex_tpu.obs.metrics import (
     histogram,
     instrument_step,
 )
+from apex_tpu.obs.reqtrace import EVENT_KINDS, RequestTracer
 from apex_tpu.obs.spans import current_path, span, traced_span
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "LATENCY_BUCKETS",
     "counter", "gauge", "histogram", "get_registry", "instrument_step",
     "span", "current_path", "traced_span",
-    "xplane",
+    "EVENT_KINDS", "FlightRecorder", "RequestTracer",
+    "fleet", "xplane",
 ]

@@ -1,0 +1,107 @@
+"""Incident flight recorder: a bounded ring buffer of recent events.
+
+The r02 wedge taught the repo to leave an incident ARTIFACT; PR 7
+taught the artifact to embed a resolved metrics snapshot.  What both
+still miss is *history*: a watchdog timeout or a divergence rewind
+ships the final gauge values, not the sequence of events that led to
+the wedge — the overflow storm's firings, the checkpoint that was
+skipped, the reroute that overloaded the replica that then hung.  This
+module is the black box: a fixed-capacity ring of host-side event
+records that subsystems note into as they go, cheap enough to run
+always (one dict + deque append per event, microseconds — the
+``OBS_r02.json`` tracing lane gates the cost), and bounded so a
+month-long run holds exactly the last ``capacity`` events when the
+incident fires.
+
+Consumers:
+
+- :func:`apex_tpu.resilience.run_resilient` notes step resolutions,
+  overflows, checkpoints, rewinds, watchdog firings and injected
+  faults, and every incident it writes embeds the recorder's tail
+  under the INCIDENT schema's optional validated ``flight`` field
+  (:func:`apex_tpu.resilience.incidents.validate_incident`);
+- :meth:`apex_tpu.serve.DisaggRouter.kill_replica` notes the kill and
+  every reroute, and dumps the tail into a replica-death incident when
+  ``RouterConfig.incident_path`` is set;
+- ``tools/chaos_run.py`` asserts the dumped tail actually CONTAINS the
+  injected fault's events (a flight recorder that misses the crash it
+  flew through is schema-shaped noise).
+
+Like the metrics fast path, ``note()`` takes **host values only** —
+it is called at step boundaries where every scalar is already a plain
+number; a device value belongs in the registry's lagged path, not
+here.  :meth:`FlightRecorder.note_metrics` records a *resolved*
+registry snapshot (compacted: counter/gauge values, histogram
+count+sum) — never a device fetch, the same
+watchdog-must-not-block-on-the-wedged-device rule the incident
+``metrics`` field follows.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, Optional
+
+__all__ = ["FlightRecorder"]
+
+
+class FlightRecorder:
+    """Bounded ring of ``{"ts", "kind", ...}`` event records (see the
+    module docstring).  ``ts`` is seconds since the recorder's
+    construction (monotonic — incident timelines need ordering and
+    spacing, not wall-clock epochs)."""
+
+    def __init__(self, capacity: int = 256):
+        if capacity < 1:
+            raise ValueError(f"capacity={capacity}")
+        self.capacity = capacity
+        self.dropped = 0
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._events: Deque[Dict[str, Any]] = deque(maxlen=capacity)
+
+    def note(self, kind: str, **data: Any) -> None:
+        """Append one event (host values only; a full ring drops the
+        oldest and counts it)."""
+        if not kind:
+            raise ValueError("flight event needs a non-empty kind")
+        # per-event hot path (gated in OBS_r02's tracing lane): reuse
+        # the **data dict instead of building a second one.  ts is
+        # stamped INSIDE the lock — a concurrent noter (the watchdog
+        # thread racing the main loop) must not append out of ts
+        # order, which the incident schema's validator rejects
+        data["kind"] = kind
+        with self._lock:
+            data["ts"] = round(time.perf_counter() - self._t0, 6)
+            if len(self._events) == self.capacity:
+                self.dropped += 1
+            self._events.append(data)
+
+    def note_metrics(self, registry) -> None:
+        """Record a compact snapshot of the registry's RESOLVED state
+        (counter/gauge values; histograms as count + sum) — one ring
+        event, never a device fetch (call after a ``tick``/``flush``
+        if the lag window matters)."""
+        compact: Dict[str, Any] = {}
+        for row in registry.snapshot()["metrics"]:
+            if row["type"] == "histogram":
+                compact[row["name"]] = {"count": row["count"],
+                                        "sum": row["sum"]}
+            else:
+                compact[row["name"]] = row["value"]
+        self.note("metrics", values=compact)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def dump(self) -> dict:
+        """The black box's tail, in the INCIDENT ``flight`` shape:
+        ``{"capacity", "dropped", "events": [...]}`` (events oldest
+        first — the ring's surviving window)."""
+        with self._lock:
+            return {"capacity": self.capacity,
+                    "dropped": int(self.dropped),
+                    "events": [dict(e) for e in self._events]}

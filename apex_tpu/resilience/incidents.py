@@ -27,6 +27,13 @@ Schema (the r02 artifact is the reference instance):
   and gauges said when the incident fired.  The resilience loop embeds
   one automatically; records without it (the r02 wedge predates the
   obs layer) stay valid;
+- ``flight``    (optional) — the flight-recorder tail in the
+  :meth:`apex_tpu.obs.flight.FlightRecorder.dump` shape
+  (``{"capacity": int, "dropped": int, "events": [{"ts": number,
+  "kind": str, ...}, ...]}``): the last-N-events black box of what led
+  to the incident, not just the end-state gauges.  The resilience loop
+  and the disaggregated router's replica-death path embed one; records
+  without it (the r02 wedge predates the recorder) stay valid;
 - anything else is free-form context (``artifact``, ``summary``,
   ``harness``, ``mitigations_added``, ...).
 """
@@ -83,6 +90,7 @@ def validate_incident(obj: Any) -> List[str]:
                     problems.append(
                         f"evidence[{i}] must be str or object, got "
                         f"{type(entry).__name__}")
+    problems.extend(_validate_flight(obj.get("flight")))
     snap = obj.get("metrics")
     if snap is not None:
         rows = snap.get("metrics") if isinstance(snap, dict) else None
@@ -92,6 +100,56 @@ def validate_incident(obj: Any) -> List[str]:
             problems.append(
                 "'metrics' present but not a registry snapshot "
                 "({'metrics': [{'name': ..., 'type': ...}, ...]})")
+    return problems
+
+
+def _validate_flight(flight: Any) -> List[str]:
+    """Problems with an optional ``flight`` field (``[]`` when absent
+    or valid): the :meth:`~apex_tpu.obs.flight.FlightRecorder.dump`
+    shape — bounded ring metadata plus ordered event records each
+    carrying a numeric ``ts`` and a non-empty ``kind``."""
+    if flight is None:
+        return []
+    if not isinstance(flight, dict):
+        return [f"'flight' must be an object, got "
+                f"{type(flight).__name__}"]
+    problems: List[str] = []
+    cap = flight.get("capacity")
+    if not (isinstance(cap, int) and not isinstance(cap, bool)
+            and cap >= 1):
+        problems.append("flight.capacity must be an int >= 1")
+    dropped = flight.get("dropped")
+    if not (isinstance(dropped, int) and not isinstance(dropped, bool)
+            and dropped >= 0):
+        problems.append("flight.dropped must be an int >= 0")
+    events = flight.get("events")
+    if not isinstance(events, list):
+        problems.append("flight.events must be a list")
+        return problems
+    if isinstance(cap, int) and not isinstance(cap, bool) \
+            and len(events) > cap:
+        problems.append(
+            f"flight holds {len(events)} events over its stated "
+            f"capacity {cap} — a ring that overflows its own bound is "
+            f"a contradiction")
+    last_ts = None
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            problems.append(f"flight.events[{i}] must be an object")
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or isinstance(ts, bool):
+            problems.append(f"flight.events[{i}] missing numeric 'ts'")
+        elif last_ts is not None and ts < last_ts:
+            problems.append(
+                f"flight.events[{i}] ts {ts} precedes its predecessor "
+                f"{last_ts} — ring events must be ordered")
+        else:
+            last_ts = ts
+        kind = ev.get("kind")
+        if not (isinstance(kind, str) and kind.strip()):
+            problems.append(
+                f"flight.events[{i}] missing non-empty str 'kind'")
     return problems
 
 

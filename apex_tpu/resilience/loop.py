@@ -47,6 +47,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 
 from apex_tpu.obs import metrics as obs_metrics
+from apex_tpu.obs.flight import FlightRecorder
 from apex_tpu.resilience import incidents as incidents_lib
 from apex_tpu.resilience.faults import FaultInjector, SimulatedPreemption
 
@@ -101,6 +102,10 @@ class RunResult:
     rewinds: int
     events: List[dict]
     incidents: List[dict]
+    #: the loop's flight recorder (ring of step/overflow/fault/rewind
+    #: events) — callers writing their own post-run incident records
+    #: embed ``flight.dump()`` the way the loop's in-flight incidents do
+    flight: Optional[FlightRecorder] = None
 
 
 def run_resilient(
@@ -113,6 +118,7 @@ def run_resilient(
     config: Optional[ResilienceConfig] = None,
     injector: Optional[FaultInjector] = None,
     registry: Optional[obs_metrics.Registry] = None,
+    flight: Optional[FlightRecorder] = None,
 ) -> RunResult:
     """Drive ``step_fn(state, *batch) -> (state, metrics)`` for
     ``num_steps`` with the protections in the module docstring.
@@ -134,7 +140,12 @@ def run_resilient(
     points where the scalars are already host values, so the shared
     registry adds **zero** host syncs; incident records embed a
     ``metrics`` snapshot of the resolved state (never a device fetch —
-    a watchdog incident must not block on the very device that hung).
+    a watchdog incident must not block on the very device that hung)
+    and the ``flight`` tail of the loop's
+    :class:`~apex_tpu.obs.flight.FlightRecorder` (``flight=`` to share
+    one across restarts; default a fresh 256-event ring) — the
+    step/overflow/checkpoint/fault/rewind history that LED to the
+    incident, returned on :attr:`RunResult.flight` either way.
     Steps you hand here should NOT also be wrapped with
     :func:`apex_tpu.obs.metrics.instrument_step` (double counting).
 
@@ -156,6 +167,12 @@ def run_resilient(
     events: List[dict] = []
     written_incidents: List[dict] = []
     losses: List[Tuple[int, float]] = []
+
+    # the black box: every step/overflow/checkpoint/fault/rewind notes
+    # into the bounded ring, and every incident written below ships the
+    # ring's tail — the last-N-events history, not just final gauges
+    fr = flight if flight is not None else FlightRecorder()
+    seen_inj = len(injector.events) if injector is not None else 0
 
     reg = registry if registry is not None else obs_metrics.DEFAULT
     m_steps = reg.counter("train_steps_total",
@@ -180,13 +197,39 @@ def run_resilient(
     # interrupt_main only helps when it IS the main thread
     entry_thread = threading.current_thread()
 
+    def _note_new_faults() -> None:
+        """Mirror freshly fired injector events into the flight ring
+        (called after each dispatch and before every incident write —
+        a Preempt raises out of the dispatch before the loop's own
+        diff point)."""
+        nonlocal seen_inj
+        if injector is None:
+            return
+        # under the loop lock: the watchdog thread mirrors through
+        # _write_incident concurrently with the main loop's per-step
+        # call, and an unguarded cursor would duplicate fault events
+        # in the forensic record
+        with lock:
+            fresh = injector.events[seen_inj:]
+            seen_inj = len(injector.events)
+        for ev in fresh:
+            # injector payload keys may collide with the ring's own
+            # fields (CorruptCheckpoint records kind="truncate") —
+            # prefix those instead of exploding note()'s signature
+            fr.note("fault", **{
+                ("fault_" + k if k in ("kind", "ts") else k): v
+                for k, v in ev.items() if k != "utc"})
+
     def _write_incident(status: str, summary: str,
                         evidence: List[Any], **extra: Any) -> None:
         try:
             # embed the RESOLVED metrics state (no flush: a watchdog
             # incident fires while the device may be wedged — snapshot
-            # must never device_get)
+            # must never device_get) and the flight recorder's tail
+            # (the event history that LED here, not just end gauges)
+            _note_new_faults()
             extra.setdefault("metrics", reg.snapshot())
+            extra.setdefault("flight", fr.dump())
             if cfg.incident_path:
                 rec = incidents_lib.write_incident(
                     cfg.incident_path, status, summary, evidence, **extra)
@@ -214,6 +257,9 @@ def run_resilient(
                     frames = traceback.format_stack(frame)
             except Exception:
                 pass
+            fr.note("watchdog", step=step_i,
+                    elapsed_s=round(elapsed, 3),
+                    budget_s=cfg.watchdog_timeout_s)
             _write_incident(
                 "watchdog-timeout",
                 f"step {step_i} exceeded the {cfg.watchdog_timeout_s}s "
@@ -253,6 +299,7 @@ def run_resilient(
                                if hasattr(st, "master_params") else st)):
             events.append({"event": "checkpoint_skipped_nonfinite",
                            "step": step_i})
+            fr.note("checkpoint_skipped_nonfinite", step=step_i)
             return
         nonlocal mem_snapshot
         if manager is not None:
@@ -274,6 +321,10 @@ def run_resilient(
                                 ("tree", jax.tree.map(np.asarray, st)))
         events.append({"event": "checkpoint", "step": step_i})
         m_ckpts.inc()
+        fr.note("checkpoint", step=step_i)
+        # the periodic resolved-metrics snapshot riding the checkpoint
+        # cadence — the "what did the gauges say then" half of the ring
+        fr.note_metrics(reg)
 
     def _rewind(st: Any, reason: str) -> Tuple[Any, int]:
         nonlocal rewinds, consecutive_pinned
@@ -318,6 +369,8 @@ def run_resilient(
         events.append({"event": "rewind", "to_step": restored,
                        "reason": reason, "rewind_count": rewinds})
         m_rewinds.inc()
+        fr.note("rewind", to_step=restored, reason=reason,
+                rewind_count=rewinds)
         return new_state, restored + 1
 
     # -- main loop --------------------------------------------------------
@@ -353,6 +406,10 @@ def run_resilient(
         if t0 is not None:
             m_margin.set(cfg.watchdog_timeout_s
                          - (time.monotonic() - t0))
+        fr.note("step", step=j, loss=round(loss, 6),
+                overflow=overflow)
+        if overflow:
+            fr.note("overflow", step=j, pinned_at_floor=pinned)
         if overflow and pinned:
             consecutive_pinned += 1
         else:
@@ -380,6 +437,7 @@ def run_resilient(
                     if injector is not None:
                         injector.on_step_start(i)
                         batch = injector.poison_batch(i, batch)
+                        _note_new_faults()
                     state, metrics = step_fn(state, *batch)
                     pending.append((i, metrics))
                 # resolve lagged metrics (all of them once dispatch is done)
@@ -435,7 +493,11 @@ def run_resilient(
                 import sys as _sys
                 if _sys.exc_info()[0] is None:
                     raise
+        # a fault firing on an ASYNC commit (checkpoint corruption)
+        # can land after the loop's last dispatch-side diff — sweep
+        # the stragglers so the returned ring is complete
+        _note_new_faults()
 
     return RunResult(state=state, steps_completed=steps_completed,
                      losses=losses, rewinds=rewinds, events=events,
-                     incidents=written_incidents)
+                     incidents=written_incidents, flight=fr)

@@ -250,9 +250,20 @@ class ServeEngine:
 
     def __init__(self, params, cfg: GPTConfig, serve_cfg: ServeConfig,
                  registry: Optional[obs_metrics.Registry] = None,
-                 placement: Optional[Any] = None):
+                 placement: Optional[Any] = None,
+                 tracer: Optional[Any] = None,
+                 trace_name: str = "engine"):
         self.cfg = cfg
         self.scfg = serve_cfg
+        #: per-request lifecycle tracer (apex_tpu.obs.reqtrace) + this
+        #: engine's component label in the fleet ("prefill",
+        #: "replica0", ...).  None = tracing off: every hook below is
+        #: one `is not None` check.  Tracing is strictly host-side at
+        #: the existing step boundaries — the compiled programs are
+        #: untouched, which is why the graph-lint syncs pass stays
+        #: clean on the instrumented lanes (OBS_r02's evidence).
+        self.tracer = tracer
+        self.trace_name = trace_name
         #: committed sharding pinning this engine to one mesh slice
         #: (the disaggregated fleet's replica isolation —
         #: :mod:`apex_tpu.serve.transfer`); None = process default
@@ -321,6 +332,9 @@ class ServeEngine:
         #: (shape drift across admit/retire) increments these past 1;
         #: tests assert they stay there across a whole mixed stream
         self.trace_counts = {"decode": 0, "prefill": 0, "sample1": 0}
+        #: decode-step dispatches (the per-request trace's ``step``
+        #: index — host bookkeeping, not the compiled program's)
+        self._steps_dispatched = 0
         self._decode_step = jax.jit(self._decode_body,
                                     donate_argnums=(2,))
         #: what step() dispatches: the jit wrapper by default, or the
@@ -474,6 +488,9 @@ class ServeEngine:
 
     def submit(self, req: Request) -> None:
         self.sched.submit(req)
+        if self.tracer is not None:
+            self.tracer.record("enqueue", req.uid, self.trace_name,
+                               queue_depth=len(self.sched.queue))
 
     def _run_prefill(self, slot: int, req: Request) -> None:
         c = self.scfg.prefill_chunk
@@ -493,6 +510,10 @@ class ServeEngine:
                 jnp.asarray(padded[None, j:j + c]),
                 jnp.int32(j), jnp.int32(n_valid))
             self._m_prefill.inc()
+            if self.tracer is not None:
+                self.tracer.record("prefill_chunk", req.uid,
+                                   self.trace_name, start=j,
+                                   n_valid=n_valid)
         if self._m_kv_err is not None and kv_err is not None:
             # admission-time KV quantization-error gauge: a DEFERRED
             # device value resolved by the registry's lag machinery at
@@ -517,11 +538,18 @@ class ServeEngine:
         # prefill sample itself — retire before the slot wastes a
         # decode step past its budget
         first = int(np.asarray(tok)[0])
+        if self.tracer is not None:
+            self.tracer.record("admit", req.uid, self.trace_name,
+                               slot=slot, first_token=first,
+                               prompt_len=n, tokens=1)
         done = req.max_new_tokens <= 1 or (
             req.eos_id is not None and first == req.eos_id)
         if done:
             uid, out = self.sched.retire(slot)
             self._outputs[uid] = out
+            if self.tracer is not None:
+                self.tracer.record("retire", uid, self.trace_name,
+                                   tokens_out=int(out.shape[0]))
 
     def _admit_and_evict(self) -> None:
         while True:
@@ -530,8 +558,12 @@ class ServeEngine:
                 return
             if plan[0] == "evict":
                 slot = plan[1]
+                uid = self.sched.slots[slot].request.uid
                 resume_key = np.asarray(self.carry["keys"][slot])
                 self.sched.preempt(slot, resume_key)
+                if self.tracer is not None:
+                    self.tracer.record("preempt", uid,
+                                       self.trace_name, slot=slot)
             else:
                 _, slot, req = plan
                 self._run_prefill(slot, req)
@@ -557,13 +589,25 @@ class ServeEngine:
         # decode-step latency the serve bench gates p50/p99 on
         self._m_step_s.observe(time.perf_counter() - t0)
         self._m_tokens.inc(n_act)
+        self._steps_dispatched += 1
         finished: Dict[str, np.ndarray] = {}
         for slot in range(sched.num_slots):
             if not sched.active[slot]:
                 continue
+            if self.tracer is not None:
+                # per-slot token attribution of this decode-step batch
+                # (host values: the (S,) fetch above is the stream the
+                # host needs anyway — the PR-7 zero-extra-sync contract)
+                self.tracer.record(
+                    "decode_step", sched.slots[slot].request.uid,
+                    self.trace_name, step=self._steps_dispatched,
+                    token=int(toks[slot]), batch=n_act, tokens=1)
             if sched.record_token(slot, int(toks[slot])):
                 uid, out = sched.retire(slot)
                 finished[uid] = out
+                if self.tracer is not None:
+                    self.tracer.record("retire", uid, self.trace_name,
+                                       tokens_out=int(out.shape[0]))
         self._outputs.update(finished)
         # step boundary for the registry's lag machinery: deferred
         # device values (the int8 KV admission gauge) resolve in

@@ -92,6 +92,12 @@ class RouterConfig:
     devices_per_replica: int = 1
     transfer: str = "ship"
     admit_block_util: float = 0.97
+    #: where :meth:`DisaggRouter.kill_replica` writes its incident
+    #: record (schema :mod:`apex_tpu.resilience.incidents`, with the
+    #: router's flight-recorder tail under the validated ``flight``
+    #: field).  ``None`` = no artifact; the flight ring records either
+    #: way.
+    incident_path: Optional[str] = None
 
     def __post_init__(self):
         if self.transfer not in ("ship", "recompute"):
@@ -115,7 +121,8 @@ class PrefillWorker:
     which never needs a decode slice at all."""
 
     def __init__(self, params, cfg, serve_cfg: ServeConfig,
-                 mesh, registry: Optional[obs_metrics.Registry] = None):
+                 mesh, registry: Optional[obs_metrics.Registry] = None,
+                 tracer: Optional[Any] = None):
         # the worker's pool only holds ONE in-flight prompt: one slot,
         # one slot's worth of blocks (+ trash).  Shapes that must agree
         # with the decode replicas (block_size, max_blocks_per_slot,
@@ -133,7 +140,8 @@ class PrefillWorker:
         self.placement = placement(mesh)
         self.eng = ServeEngine(params, cfg, self.scfg,
                                registry=registry or obs_metrics.Registry(),
-                               placement=self.placement)
+                               placement=self.placement,
+                               tracer=tracer, trace_name="prefill")
         self.trace_counts = {"gather": 0}
         names = [n for n in ("kc", "vc", "ks", "vs")
                  if n in self.eng.carry]
@@ -179,13 +187,16 @@ class DecodeReplica:
     view — a killed replica takes no work and steps no more."""
 
     def __init__(self, index: int, params, cfg, serve_cfg: ServeConfig,
-                 mesh, registry: Optional[obs_metrics.Registry] = None):
+                 mesh, registry: Optional[obs_metrics.Registry] = None,
+                 tracer: Optional[Any] = None):
         self.index = index
         self.mesh = mesh
         self.placement = placement(mesh)
         self.eng = ServeEngine(params, cfg, serve_cfg,
                                registry=registry or obs_metrics.Registry(),
-                               placement=self.placement)
+                               placement=self.placement,
+                               tracer=tracer,
+                               trace_name=f"replica{index}")
         self.alive = True
         self.trace_counts = {"install": 0}
         names = [n for n in ("kc", "vc", "ks", "vs")
@@ -212,28 +223,30 @@ class DecodeReplica:
                     and sched.blocks_needed(req)
                     <= sched.allocator.free_count)
 
-    def admit_shipment(self, shp: KVShipment) -> bool:
+    def admit_shipment(self, shp: KVShipment) -> Optional[int]:
         """Install a prefilled request: allocate its FULL footprint,
         scatter the shipped blocks into this replica's pools through
         the assigned page-table row, drop the PRNG key at the slot,
         and arm the slot for decode — one donated executable across
-        every installation (the slot index and row are traced)."""
+        every installation (the slot index and row are traced).
+        Returns the slot index, or ``None`` when the replica could
+        not take the shipment (dead / no slot / capacity race)."""
         eng, sched = self.eng, self.eng.sched
         free = sched.free_slots()
         if not self.alive or not free:
-            return False
+            return None
         req = shp.request
         try:
             blocks = sched.allocator.alloc(sched.blocks_needed(req), req)
         except PoolExhausted:
-            return False
+            return None
         slot = free[0]
         sched._install(slot, req, blocks)
         eng.carry = self._install(
             eng.carry, jnp.asarray(sched.page_table[slot]), shp.kv,
             jnp.int32(slot), shp.key)
         sched.arm(slot, shp.first_token, shp.prompt_len)
-        return True
+        return slot
 
     def submit(self, req: Request) -> None:
         """The recompute path: the replica re-prefills locally through
@@ -299,9 +312,19 @@ class DisaggRouter:
                  router_cfg: Optional[RouterConfig] = None,
                  devices: Optional[Sequence] = None,
                  registry: Optional[obs_metrics.Registry] = None,
-                 slices: Optional[FleetSlices] = None):
+                 slices: Optional[FleetSlices] = None,
+                 tracer: Optional[Any] = None,
+                 flight: Optional[Any] = None):
         self.rcfg = router_cfg or RouterConfig()
         self.scfg = serve_cfg
+        #: per-request lifecycle tracer (apex_tpu.obs.reqtrace): the
+        #: router MINTS the request id at admission and hands the one
+        #: tracer to the prefill worker ("prefill") and every replica
+        #: ("replica{i}"); None = tracing off
+        self.tracer = tracer
+        #: incident flight recorder (apex_tpu.obs.flight): the ring
+        #: kill_replica dumps into its incident record; None = off
+        self.flight = flight
         self.slices = slices if slices is not None else slice_fleet(
             devices,
             n_prefill_devices=self.rcfg.n_prefill_devices,
@@ -315,9 +338,11 @@ class DisaggRouter:
         self.metrics = registry if registry is not None \
             else obs_metrics.DEFAULT
         self.prefill = PrefillWorker(params, cfg, serve_cfg,
-                                     self.slices.prefill)
+                                     self.slices.prefill,
+                                     tracer=tracer)
         self.replicas: List[DecodeReplica] = [
-            DecodeReplica(i, params, cfg, serve_cfg, mesh)
+            DecodeReplica(i, params, cfg, serve_cfg, mesh,
+                          tracer=tracer)
             for i, mesh in enumerate(self.slices.decode)]
         self.queue: List[Request] = []
         self._outputs: Dict[str, np.ndarray] = {}
@@ -373,6 +398,13 @@ class DisaggRouter:
                          self.scfg.max_blocks_per_slot,
                          self.scfg.num_blocks)
         self.queue.append(req)
+        if self.tracer is not None:
+            # router admission is the request id's birthplace: mint
+            # the trace here, then every engine the request touches
+            # appends to the SAME lifecycle under its own label
+            self.tracer.mint(req.uid)
+            self.tracer.record("enqueue", req.uid, "router",
+                               queue_depth=len(self.queue))
         self._m_queue.set(float(len(self.queue)))
 
     # -- routing -------------------------------------------------------
@@ -406,9 +438,17 @@ class DisaggRouter:
             self._outputs[req.uid] = verdict[1]
             return True
         shp = transfer.ship(verdict[1], rep.placement)
-        if rep.admit_shipment(shp):
+        if self.tracer is not None:
+            self.tracer.record("kv_ship", req.uid, "router",
+                               to_replica=rep.index,
+                               nbytes=int(shp.nbytes))
+        slot = rep.admit_shipment(shp)
+        if slot is not None:
             self._m_ship.inc()
             self._m_bytes.inc(shp.nbytes)
+            if self.tracer is not None:
+                self.tracer.record("kv_install", req.uid,
+                                   f"replica{rep.index}", slot=slot)
         else:
             # transfer miss (the capacity check raced a same-boundary
             # admission): recompute-on-miss — the ORIGINAL request
@@ -478,6 +518,10 @@ class DisaggRouter:
         if not rep.alive:
             return []
         rep.alive = False
+        if self.flight is not None:
+            self.flight.note("replica_kill", replica=index,
+                             active=rep.eng.sched.n_active(),
+                             queued=len(rep.eng.sched.queue))
         rerouted: List[Request] = []
         sched = rep.eng.sched
         for slot in range(sched.num_slots):
@@ -501,6 +545,47 @@ class DisaggRouter:
         # them unchanged
         rerouted.extend(sched.queue)
         self.queue[:0] = rerouted
+        for r in rerouted:
+            if self.tracer is not None:
+                # every reroute NAMES the killed replica — the TRACE
+                # schema rejects a reroute citing a live one
+                self.tracer.record("reroute", r.uid, "router",
+                                   from_replica=index)
+            if self.flight is not None:
+                self.flight.note("reroute", uid=r.uid,
+                                 from_replica=index)
         self._m_reroute.inc(len(rerouted))
         self._m_queue.set(float(len(self.queue)))
+        if self.rcfg.incident_path:
+            self._write_kill_incident(index, [r.uid for r in rerouted])
         return [r.uid for r in rerouted]
+
+    def _write_kill_incident(self, index: int,
+                             rerouted: List[str]) -> None:
+        """The replica death's black box: a schema-valid incident
+        record carrying the resolved router metrics AND the flight
+        ring's tail (the events that led here — admissions, ships,
+        the kill, the reroutes), so the chaos drill interrogates a
+        history instead of two end-state gauges."""
+        from apex_tpu.resilience import incidents as incidents_lib
+        extra: Dict[str, Any] = {
+            "artifact": "disagg-router replica-death record",
+            "replica": index, "rerouted": rerouted,
+            "metrics": self.metrics.snapshot(),
+        }
+        if self.flight is not None:
+            extra["flight"] = self.flight.dump()
+        try:
+            incidents_lib.write_incident(
+                self.rcfg.incident_path, "replica-killed",
+                f"decode replica {index} lost mid-stream; "
+                f"{len(rerouted)} request(s) rebuilt from the "
+                f"streamed-token log and re-prefilled elsewhere",
+                [f"replica {index} killed with "
+                 f"{len(rerouted)} in-flight/queued request(s)",
+                 {"rerouted_uids": rerouted}],
+                **extra)
+        except Exception:
+            import traceback
+            traceback.print_exc()   # the drill must not die on its
+            #                         own forensics

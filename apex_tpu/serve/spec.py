@@ -151,7 +151,9 @@ class SpecEngine(ServeEngine):
                  draft_params, draft_cfg: GPTConfig,
                  spec_cfg: Optional[SpecConfig] = None,
                  registry: Optional[obs_metrics.Registry] = None,
-                 placement: Optional[Any] = None):
+                 placement: Optional[Any] = None,
+                 tracer: Optional[Any] = None,
+                 trace_name: str = "engine"):
         if draft_cfg.vocab_size != cfg.vocab_size:
             raise ValueError(
                 f"draft vocab {draft_cfg.vocab_size} != target vocab "
@@ -159,7 +161,8 @@ class SpecEngine(ServeEngine):
                 f"of the target's vocabulary")
         super().__init__(params, cfg,
                          dataclasses.replace(serve_cfg, aot_cache=False),
-                         registry=registry, placement=placement)
+                         registry=registry, placement=placement,
+                         tracer=tracer, trace_name=trace_name)
         self.spec = spec_cfg or SpecConfig()
         self.dcfg = draft_cfg
         self.dstacked = _stack_layer_params(draft_params,
@@ -449,17 +452,41 @@ class SpecEngine(ServeEngine):
         if self._m_proposed.value:
             self._m_accept_rate.set(
                 self._m_accepted.value / self._m_proposed.value)
+        self._steps_dispatched += 1
         finished: Dict[str, np.ndarray] = {}
         emitted = 0
         for slot in range(sched.num_slots):
             if not sched.active[slot]:
                 continue
+            uid = sched.slots[slot].request.uid
+            slot_emitted = 0
+            retired = None
             for t in range(int(n_emit[slot])):
                 emitted += 1
+                slot_emitted += 1
                 if sched.record_token(slot, int(cand[slot, t])):
-                    uid, out = sched.retire(slot)
-                    finished[uid] = out
+                    retired = sched.retire(slot)
                     break
+            if self.tracer is not None:
+                # the speculative round's per-slot attribution: the
+                # draft's proposals and the verify outcome (accepted
+                # count + emitted tokens incl. the target's own draw)
+                # — all host numbers off the (S,) n_emit fetch the
+                # loop needs anyway
+                self.tracer.record(
+                    "spec_draft", uid, self.trace_name,
+                    step=self._steps_dispatched, proposed=k)
+                self.tracer.record(
+                    "spec_verify", uid, self.trace_name,
+                    step=self._steps_dispatched,
+                    accepted=int(n_emit[slot]) - 1,
+                    tokens=slot_emitted)
+            if retired is not None:
+                finished[retired[0]] = retired[1]
+                if self.tracer is not None:
+                    self.tracer.record(
+                        "retire", retired[0], self.trace_name,
+                        tokens_out=int(retired[1].shape[0]))
         self._m_tokens.inc(emitted)
         self._outputs.update(finished)
         self.metrics.tick()

@@ -788,28 +788,6 @@ def bench_serve_spec(warmup: int, iters: int, peak: float,
             "ab_ok": bool(ab_ok)}
 
 
-def _merged_decode_quantile(pairs, q: float) -> float:
-    """Fleet-level decode-step quantile: union the replicas' own
-    ``serve_decode_step_seconds`` windows (same fixed bucket ladder)
-    and interpolate through the SAME :class:`~apex_tpu.obs.metrics.
-    Histogram` math bench and a production scrape use — never a
-    private percentile implementation."""
-    from apex_tpu.obs.metrics import Histogram, Registry
-
-    merged = Histogram(Registry(), "_merged_decode_window")
-    for hist, mark in pairs:
-        merged.counts = merged.counts + (hist.counts - mark[0])
-        merged.sum += hist.sum - mark[1]
-        merged.count += hist.count - mark[2]
-        # the window's max is only known when it SET the running max —
-        # the same stale-max guard Histogram.quantile(since=) applies,
-        # or an excluded pre-mark compile step would stretch the
-        # overflow bucket of the merged window
-        if hist._max > mark[3]:
-            merged._max = max(merged._max, hist._max)
-    return merged.quantile(q)
-
-
 def bench_serve_disagg(warmup: int, iters: int, peak: float,
                        n_replicas: int = 2, slots_per_replica: int = 8,
                        prefill: int = 512, new_tokens: int = 128,
@@ -843,6 +821,7 @@ def bench_serve_disagg(warmup: int, iters: int, peak: float,
 
     from apex_tpu import amp
     from apex_tpu.models.gpt import GPTModel, gpt_small_tpu, gpt_tiny
+    from apex_tpu.obs import fleet as fleet_obs
     from apex_tpu.obs.metrics import Registry
     from apex_tpu.serve import (DisaggRouter, Request, RouterConfig,
                                 ServeConfig, ServeEngine)
@@ -943,9 +922,9 @@ def bench_serve_disagg(warmup: int, iters: int, peak: float,
         "slots_per_replica": slots_per_replica,
         "n_replicas": n_replicas,
         "tok_s": round(produced / wall, 2) if wall else 0.0,
-        "p50_ms": round(_merged_decode_quantile(
+        "p50_ms": round(fleet_obs.merged_quantile(
             list(zip(hists, marks)), 0.5) * 1e3, 3),
-        "p99_ms": round(_merged_decode_quantile(
+        "p99_ms": round(fleet_obs.merged_quantile(
             list(zip(hists, marks)), 0.99) * 1e3, 3),
         "per_replica": per_replica,
         "retraces": [r.eng.trace_counts["decode"]

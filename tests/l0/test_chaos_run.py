@@ -35,6 +35,62 @@ def test_chaos_smoke_nan_storm_fast(tmp_path):
     assert validate_incident(rec) == []
     assert rec["status"] == "recovered"
     assert "nan_storm" in json.dumps(rec)
+    # the incident flight recorder (ISSUE 13): the dumped tail is
+    # schema-valid (validate_incident above covered the shape) and
+    # actually CONTAINS the injected fault's events — the nan-storm
+    # firings and the rewind they forced — not just end-state gauges
+    assert chaos_run.check_flight(rec, ["nan_storm@3"], 1) == []
+    kinds = [e["kind"] for e in rec["flight"]["events"]]
+    assert "rewind" in kinds and "fault" in kinds
+    assert any(e.get("fault") == "nan_storm"
+               for e in rec["flight"]["events"])
+    # ring events stay ordered and bounded by the stated capacity
+    ts = [e["ts"] for e in rec["flight"]["events"]]
+    assert ts == sorted(ts)
+    assert len(ts) <= rec["flight"]["capacity"]
+
+
+def test_flight_survives_fault_payloads_with_kind_key(tmp_path):
+    """Regression (review round): CorruptCheckpoint's injector event
+    carries its own ``kind`` key ("truncate"); mirroring it into the
+    flight ring must prefix the colliding field, not explode
+    ``FlightRecorder.note``'s signature (which aborted the run
+    mid-loop before the fix)."""
+    out = tmp_path / "INCIDENT_kind_collision.json"
+    rc = chaos_run.main([
+        "--steps", "6",
+        "--faults", "ckpt_truncate@2",
+        "--checkpoint-every", "2",
+        "--ckpt-dir", str(tmp_path / "ckpt"),
+        "--out", str(out),
+    ])
+    assert rc == 0
+    rec = json.loads(out.read_text())
+    assert validate_incident(rec) == []
+    faults = [e for e in rec["flight"]["events"]
+              if e["kind"] == "fault"]
+    assert any(e.get("fault") == "corrupt_checkpoint"
+               and e.get("fault_kind") == "truncate" for e in faults)
+
+
+def test_chaos_run_long_run_keeps_early_faults_in_tail(tmp_path):
+    """Regression (review round): the flight ring is sized to the run
+    so an early injected fault is never evicted from the tail
+    check_flight judges the run by — a recovered long run must not
+    exit 1 because its own black box forgot the crash."""
+    out = tmp_path / "INCIDENT_long.json"
+    rc = chaos_run.main([
+        "--steps", "90",
+        "--faults", "nan_storm@3:2",
+        "--checkpoint-every", "30",
+        "--patience", "2",
+        "--ckpt-dir", str(tmp_path / "ckpt"),
+        "--out", str(out),
+    ])
+    assert rc == 0
+    rec = json.loads(out.read_text())
+    assert rec["flight"]["capacity"] >= 90 * 4
+    assert chaos_run.check_flight(rec, ["nan_storm@3:2"], None) == []
 
 
 @pytest.mark.slow

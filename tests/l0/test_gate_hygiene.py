@@ -753,3 +753,137 @@ def test_repo_scenario_validates():
     doc = json.loads(arts[-1].read_text())
     assert len(doc["cells"]) >= 10
     assert doc["gate"]["ok"] is True
+
+
+# ---------------------------------------------------------------------------
+# TRACE_r*.json — the request-trace artifacts (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+def _resilience_module(repo, stem):
+    src = REPO / "apex_tpu" / "resilience" / f"{stem}.py"
+    dst = repo / "apex_tpu" / "resilience"
+    dst.mkdir(parents=True, exist_ok=True)
+    (dst / f"{stem}.py").write_text(src.read_text())
+
+
+def _valid_trace():
+    return {
+        "round": 1, "platform": "cpu", "config": {"model": "gpt_tiny"},
+        "requests": {
+            "a": {
+                "trace_id": "t00001",
+                "events": [
+                    {"seq": 1, "ts": 0.0, "kind": "enqueue",
+                     "where": "router"},
+                    {"seq": 2, "ts": 0.1, "kind": "admit",
+                     "where": "prefill", "tokens": 1},
+                    {"seq": 3, "ts": 0.2, "kind": "decode_step",
+                     "where": "replica0", "tokens": 1},
+                    {"seq": 4, "ts": 0.3, "kind": "retire",
+                     "where": "replica0", "tokens_out": 2},
+                ],
+                "spans": [
+                    {"name": "request", "where": "*", "t0": 0.0,
+                     "t1": 0.3, "parent": -1},
+                    {"name": "replica0", "where": "replica0",
+                     "t0": 0.2, "t1": 0.3, "parent": 0},
+                ],
+                "tokens": 2,
+            },
+        },
+        "engine": {"serve_tokens_total": {"prefill": 1, "replica0": 1},
+                   "delta_total": 2},
+        "chaos": {"killed": [], "rerouted": []},
+        "gate": {"bitwise_ok": True, "tokens_ok": True, "ok": True},
+    }
+
+
+def test_committed_trace_validated_against_schema(tmp_repo):
+    _analysis_module(tmp_repo, "trace")
+    (tmp_repo / "TRACE_r09_bad.json").write_text(
+        json.dumps({"round": 9}))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "bad trace")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("TRACE_r09_bad.json" in p
+               for p in verdict["invalid_traces"])
+
+
+def test_trace_token_contradiction_fails_hygiene(tmp_repo):
+    """A trace whose token accounting disagrees with the engines' own
+    counters is CONTRADICTORY and schema-invalid."""
+    _analysis_module(tmp_repo, "trace")
+    doc = _valid_trace()
+    doc["engine"]["delta_total"] = 9
+    doc["engine"]["serve_tokens_total"] = {"replica0": 9}
+    (tmp_repo / "TRACE_r09_contra.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "contradictory trace")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("CONTRADICTION" in p for p in verdict["invalid_traces"])
+
+
+def test_trace_nonnesting_spans_fail_hygiene(tmp_repo):
+    _analysis_module(tmp_repo, "trace")
+    doc = _valid_trace()
+    doc["requests"]["a"]["spans"][1]["t1"] = 99.0
+    (tmp_repo / "TRACE_r09_spans.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "non-nesting trace")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("nest" in p for p in verdict["invalid_traces"])
+
+
+def test_valid_trace_passes_and_untracked_fails(tmp_repo):
+    _analysis_module(tmp_repo, "trace")
+    (tmp_repo / "TRACE_r09_ok.json").write_text(
+        json.dumps(_valid_trace()))
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert verdict["untracked"] == ["TRACE_r09_ok.json"]
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "good trace")
+    assert gate_hygiene.check(str(tmp_repo))["ok"]
+
+
+def test_incident_flight_field_validated_by_hygiene(tmp_repo):
+    """The INCIDENT schema's grown optional ``flight`` field rides
+    the same committed-incident validation: an unordered or
+    over-capacity ring tail fails tier-1."""
+    _resilience_module(tmp_repo, "incidents")
+    rec = {"status": "recovered", "utc": "2026-08-04T00:00:00Z",
+           "evidence": ["e"],
+           "flight": {"capacity": 4, "dropped": 0,
+                      "events": [{"ts": 1.0, "kind": "step"},
+                                 {"ts": 0.2, "kind": "rewind"}]}}
+    (tmp_repo / "INCIDENT_r09_flight.json").write_text(json.dumps(rec))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "incident w/ bad flight")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("ordered" in p for p in verdict["invalid_incidents"])
+    # fixed ordering -> valid
+    rec["flight"]["events"][1]["ts"] = 1.5
+    (tmp_repo / "INCIDENT_r09_flight.json").write_text(json.dumps(rec))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "incident w/ good flight")
+    assert gate_hygiene.check(str(tmp_repo))["ok"]
+
+
+def test_repo_trace_validates():
+    """The committed TRACE artifact is the schema's reference
+    instance; it must stay valid — and its gate must HOLD (the
+    killed request's lifecycle reconstructed, token accounting
+    closed against the engines: the ISSUE-13 acceptance bar rides
+    tests/l0/test_reqtrace.py's deeper assertion; this is the
+    hygiene wiring)."""
+    assert gate_hygiene._validate_traces(str(REPO)) == []
+    arts = sorted(REPO.glob("TRACE_r*.json"))
+    assert arts, "the trace gate artifact must be committed"
+    doc = json.loads(arts[-1].read_text())
+    assert doc["gate"]["ok"] is True
+    assert doc["chaos"]["killed"] and doc["chaos"]["rerouted"]
+    assert doc["config"]["topology"]["n_devices"] >= 16

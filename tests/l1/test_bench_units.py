@@ -642,9 +642,14 @@ def test_merged_decode_quantile_unions_replica_windows():
     """The fleet percentile is the union of the replicas' histogram
     windows through the SAME Histogram interpolation — two replicas
     with disjoint latency populations must merge to the population
-    quantile, and pre-mark observations stay outside the window."""
+    quantile, and pre-mark observations stay outside the window.
+    (bench's private ``_merged_decode_quantile`` is GONE — this is
+    the one public copy, ``apex_tpu.obs.fleet.merged_quantile``,
+    which bench_serve_disagg now imports.)"""
+    from apex_tpu.obs.fleet import merged_quantile
     from apex_tpu.obs.metrics import Histogram, Registry
 
+    assert not hasattr(bench, "_merged_decode_quantile")
     reg = Registry()
     h1, h2 = Histogram(reg, "a"), Histogram(reg, "b")
     h1.observe(10.0)                    # pre-window (compile step)
@@ -652,10 +657,8 @@ def test_merged_decode_quantile_unions_replica_windows():
     for _ in range(50):
         h1.observe(0.001)
         h2.observe(0.004)
-    merged_p50 = bench._merged_decode_quantile([(h1, m1), (h2, m2)],
-                                               0.5)
-    merged_p99 = bench._merged_decode_quantile([(h1, m1), (h2, m2)],
-                                               0.99)
+    merged_p50 = merged_quantile([(h1, m1), (h2, m2)], 0.5)
+    merged_p99 = merged_quantile([(h1, m1), (h2, m2)], 0.99)
     # half the union sits near 1 ms, the slow half near 4 ms: p50
     # lands between the two modes, p99 inside the slow replica's
     # bucket — and far under the excluded 10 s compile outlier
@@ -669,7 +672,7 @@ def test_merged_decode_quantile_unions_replica_windows():
     h3.observe(100.0)
     m3 = h3.state()
     h3.observe(30.0)
-    merged = bench._merged_decode_quantile([(h3, m3)], 0.99)
+    merged = merged_quantile([(h3, m3)], 0.99)
     assert merged == h3.quantile(0.99, since=m3)
     assert merged <= 30.0
 

@@ -25,6 +25,14 @@ cost (bare jitted loop vs ``run_resilient`` with no faults and no
 checkpointing) and records it in the artifact — the "< 2% step time"
 budget documented in ``docs/source/checkpoint.rst``.
 
+The emitted incident embeds the loop's **flight-recorder tail**
+(:class:`apex_tpu.obs.flight.FlightRecorder` — the bounded ring of
+step/overflow/fault/rewind events), and the harness ASSERTS that tail
+is schema-valid and actually contains the injected faults' events (a
+scheduled nan storm must appear as ``fault`` firings, an executed
+rewind as a ``rewind`` event): a black box that missed the crash it
+flew through fails the run, not just the review.
+
 Usage::
 
     python tools/chaos_run.py --steps 24 \
@@ -152,6 +160,39 @@ def measure_overhead(steps: int = 40, reps: int = 5, seed: int = 0) -> dict:
                 round(100.0 * (wrap_t - bare_t) / bare_t, 2)}
 
 
+def check_flight(rec: dict, fault_specs, rewinds) -> list:
+    """Problems with the incident's flight tail as a black box of this
+    run (``[]`` = covered): the ``flight`` field must be present and
+    schema-valid (``validate_incident`` already enforces the shape —
+    this re-checks so the verdict is usable standalone), every
+    scheduled nan-storm must appear among its ``fault`` events, and an
+    executed rewind must appear as a ``rewind`` event."""
+    from apex_tpu.resilience.incidents import _validate_flight
+
+    flight = rec.get("flight")
+    if flight is None:
+        return ["incident carries no 'flight' field — the loop's ring "
+                "was not dumped"]
+    problems = [f"flight: {p}" for p in _validate_flight(flight)]
+    events = flight.get("events") if isinstance(flight, dict) else []
+    if not isinstance(events, list):
+        events = []
+    kinds = [e.get("kind") for e in events if isinstance(e, dict)]
+    fired_faults = {e.get("fault") for e in events
+                    if isinstance(e, dict) and e.get("kind") == "fault"}
+    for spec in fault_specs:
+        name = spec.partition("@")[0].partition(":")[0]
+        if name == "nan_storm" and "nan_storm" not in fired_faults:
+            problems.append(
+                f"flight tail never recorded the scheduled {spec!r} "
+                f"firing (fault kinds seen: {sorted(fired_faults)})")
+    if rewinds and "rewind" not in kinds:
+        problems.append(
+            f"loop rewound {rewinds}x but the flight tail has no "
+            f"'rewind' event (kinds seen: {sorted(set(kinds))})")
+    return problems
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -189,11 +230,20 @@ def main(argv=None) -> int:
                                         io_hook=injector.io_hook,
                                         on_commit=injector.on_commit)
 
+    from apex_tpu.obs.flight import FlightRecorder
+
     amp_obj, step_fn, state, batch_fn = build_workload(args.seed)
     restarts = 0
     status, summary = "completed", "chaos run completed"
     result = None
     evidence = []
+    # ONE flight recorder across restarts: the final incident's tail
+    # must span the whole chaos run, preemption restarts included.
+    # Capacity is sized to the run (the loop notes up to ~4 events per
+    # step): check_flight below DEMANDS the injected faults' events in
+    # the tail, so a long run must not evict an early fault's firing
+    # out of the black box it is later judged by.
+    flight = FlightRecorder(capacity=max(256, args.steps * 4 + 64))
     with injector:
         remaining = True
         while remaining:
@@ -202,7 +252,8 @@ def main(argv=None) -> int:
             try:
                 result = run_resilient(
                     step_fn, state, batch_fn, args.steps, amp_obj=amp_obj,
-                    manager=manager, config=cfg, injector=injector)
+                    manager=manager, config=cfg, injector=injector,
+                    flight=flight)
             except SimulatedPreemption as e:
                 # scheduler restart: fresh process state, restore from the
                 # last GOOD (checksum-verified) snapshot, resume
@@ -246,20 +297,30 @@ def main(argv=None) -> int:
     extra = {"artifact": "chaos-run fault-injection record",
              "harness": "tools/chaos_run.py -> apex_tpu.resilience",
              "faults": list(args.faults), "restarts": restarts,
-             "checkpoint_dir": ckpt_dir}
+             "checkpoint_dir": ckpt_dir,
+             "flight": flight.dump()}
     if args.overhead:
         extra["overhead"] = measure_overhead(seed=args.seed)
 
     from apex_tpu.resilience import write_incident
     rec = write_incident(args.out, status, summary, evidence, **extra)
+    # the black-box bar: the dumped tail must be schema-valid AND
+    # contain the injected faults' events — a completed chaos run whose
+    # flight recorder missed the injected crash fails here
+    flight_problems = check_flight(rec, args.faults,
+                                   getattr(result, "rewinds", 0))
+    if flight_problems:
+        print(f"chaos_run: flight-recorder tail incomplete: "
+              f"{flight_problems}", file=sys.stderr)
     print(json.dumps({"status": rec["status"], "out": args.out,
                       "restarts": restarts,
                       "rewinds": getattr(result, "rewinds", None),
                       "final_loss": final_loss,
+                      "flight_events": len(rec["flight"]["events"]),
                       **({"overhead": extra["overhead"]}
                          if args.overhead else {})}))
     ok = status in ("completed", "recovered") and final_loss is not None \
-        and np.isfinite(final_loss)
+        and np.isfinite(final_loss) and not flight_problems
     return 0 if ok else 1
 
 

@@ -70,7 +70,17 @@ This check fails (exit 1) when
   that AGREES with its own numbers, a spec-vs-baseline A/B whose
   ``spec_wins`` rows agree with the tokens-per-step numbers they
   cite) — "handles many scenarios" and the speculative-decoding
-  latency win are gate memory, not prose.
+  latency win are gate memory, not prose, or
+- a committed ``TRACE_r*.json`` does not validate against the
+  request-trace schema (``apex_tpu/analysis/trace.py``: per-request
+  lifecycles whose span trees NEST, token accounting that equals the
+  engines' own ``serve_tokens_total`` deltas, every reroute naming a
+  killed replica, and a gate agreeing with its own numbers — a
+  contradictory trace is schema-invalid) — the fleet's request-level
+  forensic record is gate memory like every other artifact.  The
+  incident schema's grown optional ``flight`` field (the
+  flight-recorder tail) is validated through the same committed
+  ``INCIDENT_r*.json`` check above.
 
 It is wired into tier-1 (``tests/l0/test_gate_hygiene.py``), so a round
 cannot go green with dirty gate memory.  Best-effort on the VCS side:
@@ -105,7 +115,8 @@ PATTERNS = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json",
             "PRECLINT_r*.json", "DECODE_DECOMPOSE_r*.json",
             "OBS_r*.json", "DECODE_PROFILE_r*.json",
             "CONVERGENCE_r*.json", "EXPORT_r*.json",
-            "SERVE_DISAGG_r*.json", "SCENARIO_r*.json")
+            "SERVE_DISAGG_r*.json", "SCENARIO_r*.json",
+            "TRACE_r*.json")
 
 #: Round-numbered incident artifacts additionally get schema-validated.
 INCIDENT_PATTERN = "INCIDENT_r*.json"
@@ -134,8 +145,11 @@ EXPORT_PATTERN = "EXPORT_r*.json"
 #: ... and the disaggregated-serving gate artifacts ...
 SERVE_DISAGG_PATTERN = "SERVE_DISAGG_r*.json"
 
-#: ... and the serve scenario-matrix gate artifacts.
+#: ... and the serve scenario-matrix gate artifacts ...
 SCENARIO_PATTERN = "SCENARIO_r*.json"
+
+#: ... and the fleet request-trace artifacts.
+TRACE_PATTERN = "TRACE_r*.json"
 
 
 def _load_by_path(repo: str, *rel: str):
@@ -294,6 +308,21 @@ def _validate_scenarios(repo: str) -> "list[str]":
     return problems
 
 
+def _validate_traces(repo: str) -> "list[str]":
+    """Schema problems over every present TRACE_r*.json, as
+    ``path: problem`` strings (``apex_tpu/analysis/trace.py`` — which
+    also enforces the span-nesting / token-accounting / reroute
+    contradiction rejections)."""
+    schema = _load_by_path(repo, "apex_tpu", "analysis", "trace.py")
+    if schema is None:
+        return []
+    problems = []
+    for p in sorted(Path(repo).glob(TRACE_PATTERN)):
+        for msg in schema.validate_trace_file(str(p)):
+            problems.append(f"{p.name}: {msg}")
+    return problems
+
+
 def _git(repo: str, *args: str) -> "str | None":
     """stdout of a git command, or None when git/The repo is unavailable
     (the best-effort contract)."""
@@ -322,7 +351,7 @@ def check(repo: str = str(REPO)) -> dict:
                 "invalid_decomposes": [], "invalid_obs": [],
                 "invalid_profiles": [], "invalid_convergences": [],
                 "invalid_exports": [], "invalid_serve_disaggs": [],
-                "invalid_scenarios": []}
+                "invalid_scenarios": [], "invalid_traces": []}
     tracked = set(tracked_raw.split())
     missing = [f for f in REQUIRED
                if not (Path(repo) / f).exists() or f not in tracked]
@@ -352,11 +381,12 @@ def check(repo: str = str(REPO)) -> dict:
     invalid_exp = _validate_exports(repo)
     invalid_disagg = _validate_serve_disaggs(repo)
     invalid_scen = _validate_scenarios(repo)
+    invalid_trace = _validate_traces(repo)
     return {"ok": not (missing or untracked or dirty or invalid
                        or invalid_mem or invalid_prec or invalid_dec
                        or invalid_obs or invalid_prof or invalid_conv
                        or invalid_exp or invalid_disagg
-                       or invalid_scen),
+                       or invalid_scen or invalid_trace),
             "missing": missing, "untracked": untracked, "dirty": dirty,
             "invalid_incidents": invalid,
             "invalid_memlints": invalid_mem,
@@ -367,7 +397,8 @@ def check(repo: str = str(REPO)) -> dict:
             "invalid_convergences": invalid_conv,
             "invalid_exports": invalid_exp,
             "invalid_serve_disaggs": invalid_disagg,
-            "invalid_scenarios": invalid_scen}
+            "invalid_scenarios": invalid_scen,
+            "invalid_traces": invalid_trace}
 
 
 def main(argv=None) -> int:
@@ -393,7 +424,9 @@ def main(argv=None) -> int:
               f"export records {verdict.get('invalid_exports', [])}; "
               f"invalid serve-disagg records "
               f"{verdict.get('invalid_serve_disaggs', [])}; invalid "
-              f"scenario records {verdict.get('invalid_scenarios', [])}",
+              f"scenario records {verdict.get('invalid_scenarios', [])}; "
+              f"invalid trace records "
+              f"{verdict.get('invalid_traces', [])}",
               file=sys.stderr)
         return 1
     return 0

@@ -67,6 +67,7 @@ def chaos_drill(tiny: bool, n_replicas: int, prefill: int,
     from apex_tpu import amp
     from apex_tpu.models.generate import generate
     from apex_tpu.models.gpt import GPTModel, gpt_small_tpu, gpt_tiny
+    from apex_tpu.obs import fleet as fleet_obs
     from apex_tpu.obs.metrics import Registry
     from apex_tpu.serve import (DisaggRouter, Request, RouterConfig,
                                 ServeConfig)
@@ -103,9 +104,17 @@ def chaos_drill(tiny: bool, n_replicas: int, prefill: int,
                                    n))[0, len(p):]
         if not np.array_equal(out[f"c{i}"], want):
             bitwise = False
+    # fleet token accounting through the ONE merge implementation
+    # (apex_tpu.obs.fleet — the same counter-sum a production scrape
+    # runs; never hand-summed here so the two can't drift)
+    merged = fleet_obs.merge_registries(
+        [router.prefill.eng.metrics]
+        + [r.eng.metrics for r in router.replicas])
     return {"killed_replica": int(victim),
             "rerouted": len(rerouted),
-            "bitwise_ok": bool(bitwise)}
+            "bitwise_ok": bool(bitwise),
+            "fleet_tokens_total": int(
+                merged.counter("serve_tokens_total").value)}
 
 
 def main(argv=None) -> int:
