@@ -67,6 +67,36 @@ MIN_CELLS = 10
 ARRIVALS = ("steady", "burst")
 SAMPLINGS = ("greedy", "mixed")
 
+#: the closed SLO status vocabulary (apex_tpu.obs.slo) — cells may
+#: carry an OPTIONAL ``slo`` verdict block; when present it is
+#: validated: statuses from this vocabulary only, and the block's
+#: ``ok`` must re-derive from them (no self-citing SLO verdicts).
+SLO_STATUSES = ("met", "violated", "insufficient_window")
+
+
+def _check_slo_block(name: str, slo, problems: List[str]):
+    """Validate one optional SLO verdict block; returns its ok when
+    well-formed, else None."""
+    if not isinstance(slo, dict) or \
+            not isinstance(slo.get("objectives"), dict) or \
+            not isinstance(slo.get("ok"), bool):
+        problems.append(f"{name} must carry an 'objectives' map and "
+                        f"an 'ok' bool")
+        return None
+    violated = False
+    for oname, rec in slo["objectives"].items():
+        st = rec.get("status") if isinstance(rec, dict) else None
+        if st not in SLO_STATUSES:
+            problems.append(f"{name}.objectives[{oname}].status "
+                            f"{st!r} not in {SLO_STATUSES}")
+            return None
+        violated = violated or (st == "violated")
+    if slo["ok"] != (not violated):
+        problems.append(
+            f"CONTRADICTORY verdict: {name}.ok={slo['ok']} but the "
+            f"objective statuses derive {not violated}")
+    return slo["ok"]
+
 
 def _num(x) -> bool:
     return isinstance(x, (int, float)) and not isinstance(x, bool)
@@ -151,6 +181,8 @@ def _check_cell(name: str, cell, gate_k, problems: List[str]):
             f"CONTRADICTORY verdict: cells[{name}].gate.ok="
             f"{gate['ok']} but tail_ok={gate['tail_ok']} and "
             f"retrace_ok={gate['retrace_ok']}")
+    if cell.get("slo") is not None:
+        _check_slo_block(f"cells[{name}].slo", cell["slo"], problems)
     return gate["ok"], cell["tokens_per_step"]
 
 
@@ -233,6 +265,23 @@ def validate_scenario(doc) -> List[str]:
                 f"{row['tokens_per_step_off']} derives {derived}")
         if row["gated"]:
             ab_gated_wins.append(row["spec_wins"])
+
+    # -- the optional document-level SLO verdict ----------------------
+    doc_slo = doc.get("slo")
+    if doc_slo is not None:
+        if not isinstance(doc_slo, dict) or \
+                not isinstance(doc_slo.get("ok"), bool):
+            problems.append("'slo' block must carry an ok bool")
+        else:
+            derived_slo = all(
+                c["slo"].get("ok") is True
+                for c in cells.values()
+                if isinstance(c, dict)
+                and isinstance(c.get("slo"), dict))
+            if doc_slo["ok"] != derived_slo:
+                problems.append(
+                    f"CONTRADICTORY verdict: slo.ok={doc_slo['ok']} "
+                    f"but the cells' SLO blocks derive {derived_slo}")
 
     # -- the document verdict -----------------------------------------
     gate = doc.get("gate")

@@ -1,0 +1,176 @@
+"""BENCH_VARIANCE_r*.json — schema for the committed repeated-timing
+variance artifact (the statistics under every floor and band).
+
+``tools/bench_variance.py`` writes one of these per measurement round:
+N repeated timings per kernel / bench config, each entry carrying the
+sample statistics (``n``, ``values``, ``mean``, ``min``, ``max``,
+``std``, ``rel_spread``) that ``bench.derive_floor_bands()`` turns
+into statistical gate floors (``floor = mean − k·std``) and
+``tools/perf_timeline.py`` turns into per-series band widths.  A
+floor justified by this artifact is justified by RECORDED variance,
+not anecdote — ROADMAP item 1's "re-derive every floor and band width
+from BENCH_VARIANCE.json statistics" made committable.
+
+Contradiction rejection, like every gate schema in this family: an
+entry's recorded ``mean``/``min``/``max``/``std``/``rel_spread`` must
+AGREE with the ``values`` they summarize (within the tool's stated
+rounding) and ``n`` must equal ``len(values)`` — a spread wide enough
+to excuse a floor drop cannot be typed in, it has to be derivable
+from the recorded samples.  Error entries (``{"error": ...}``) are
+legal per-entry records (partial variance evidence beats none after
+chip time is spent) but carry no statistical weight.
+
+This module is deliberately **stdlib-only** (no jax import):
+``tools/gate_hygiene.py`` loads it directly by file path in tier-1.
+
+Document shape::
+
+    {
+      "platform": "tpu",
+      "device_kind": "TPU v5e",
+      "tiny": false,                # tiny smokes carry no evidence
+      "round": 1,
+      "entries": {
+        "kernel:fused_adam": {
+          "metric": "ms_per_step", "n": 5,
+          "values": [..], "mean": .., "min": .., "max": ..,
+          "std": .., "rel_spread": ..,
+          "roofline_frac": {"n": 5, "values": [..], "mean": ..,
+                            "min": .., "max": .., "std": ..,
+                            "rel_spread": ..},      # optional sub-stat
+          "geometry": {...}                          # optional
+        },
+        "config:gpt_small_o2": {
+          "metric": "tok_s", ...,
+          "mfu": {...}, "hbm_frac": {...}            # optional
+        },
+        "kernel:broken_one": {"error": "XlaRuntimeError: ..."}
+      }
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import List
+
+#: rounding the tool applies to values/mean/min/max (6 places) and to
+#: rel_spread (4) — the agreement tolerance below covers it.
+_VALUE_TOL = 2e-6
+_SPREAD_TOL = 2e-4
+
+#: nested sub-statistic blocks an entry may carry per metric family
+SUB_STATS = ("mfu", "hbm_frac", "roofline_frac")
+
+
+def _num(x) -> bool:
+    return isinstance(x, (int, float)) and not isinstance(x, bool)
+
+
+def _check_stats(name: str, e: dict, problems: List[str]) -> None:
+    """One stats block: n/values present and self-consistent."""
+    values = e.get("values")
+    n = e.get("n")
+    if not isinstance(values, list) or not values or \
+            not all(_num(v) for v in values):
+        problems.append(f"{name}: missing/empty 'values' list")
+        return
+    if not isinstance(n, int) or n != len(values):
+        problems.append(f"{name}: n={n!r} but values has "
+                        f"{len(values)} sample(s)")
+    for field in ("mean", "min", "max"):
+        if not _num(e.get(field)):
+            problems.append(f"{name}: missing '{field}'")
+            return
+    derived_mean = sum(values) / len(values)
+    tol = _VALUE_TOL * max(1.0, abs(derived_mean))
+    if abs(e["mean"] - derived_mean) > tol:
+        problems.append(
+            f"CONTRADICTORY record: {name}.mean={e['mean']} but the "
+            f"recorded values derive {round(derived_mean, 6)}")
+    if abs(e["min"] - min(values)) > tol or \
+            abs(e["max"] - max(values)) > tol:
+        problems.append(
+            f"CONTRADICTORY record: {name}.min/max disagree with the "
+            f"recorded values")
+    if not (e["min"] <= e["mean"] + tol and
+            e["mean"] <= e["max"] + tol):
+        problems.append(f"{name}: min <= mean <= max violated")
+    spread = e.get("rel_spread")
+    if spread is not None:
+        if not _num(spread) or spread < 0:
+            problems.append(f"{name}: rel_spread must be a "
+                            f"non-negative number")
+        elif derived_mean:
+            derived = (max(values) - min(values)) / derived_mean
+            if abs(spread - derived) > _SPREAD_TOL:
+                problems.append(
+                    f"CONTRADICTORY record: {name}.rel_spread="
+                    f"{spread} but the recorded values derive "
+                    f"{round(derived, 4)}")
+    std = e.get("std")
+    if std is not None:
+        if not _num(std) or std < 0:
+            problems.append(f"{name}: std must be a non-negative "
+                            f"number")
+        elif len(values) > 1:
+            var = sum((v - derived_mean) ** 2 for v in values) \
+                / (len(values) - 1)
+            derived_std = math.sqrt(var)
+            if abs(std - derived_std) > \
+                    _VALUE_TOL * max(1.0, derived_std):
+                problems.append(
+                    f"CONTRADICTORY record: {name}.std={std} but the "
+                    f"recorded values derive {round(derived_std, 6)}")
+
+
+def validate_variance(doc) -> List[str]:
+    """Problems with one parsed BENCH_VARIANCE document (empty =
+    valid)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not a JSON object"]
+    if not isinstance(doc.get("platform"), str):
+        problems.append("missing/invalid 'platform' (str)")
+    if not isinstance(doc.get("tiny"), bool):
+        problems.append("missing/invalid 'tiny' (bool — a tiny smoke "
+                        "must say so: its spreads are not evidence)")
+    entries = doc.get("entries")
+    if not isinstance(entries, dict) or not entries:
+        problems.append("missing/empty 'entries' map")
+        return problems
+    for key, e in sorted(entries.items()):
+        if not (isinstance(key, str)
+                and key.partition(":")[0] in ("kernel", "config")):
+            problems.append(f"entry key {key!r} must be "
+                            f"'kernel:<name>' or 'config:<name>'")
+        if not isinstance(e, dict):
+            problems.append(f"entries[{key}] is not an object")
+            continue
+        if "error" in e:
+            if not isinstance(e["error"], str) or not e["error"]:
+                problems.append(f"entries[{key}].error must be a "
+                                f"non-empty string")
+            continue
+        _check_stats(f"entries[{key}]", e, problems)
+        for sub in SUB_STATS:
+            if sub in e:
+                if not isinstance(e[sub], dict):
+                    problems.append(f"entries[{key}].{sub} is not an "
+                                    f"object")
+                else:
+                    _check_stats(f"entries[{key}].{sub}", e[sub],
+                                 problems)
+    return problems
+
+
+def validate_variance_file(path: str) -> List[str]:
+    """Problems with one BENCH_VARIANCE_r*.json file (empty =
+    valid)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable variance JSON: {e}"]
+    return validate_variance(doc)

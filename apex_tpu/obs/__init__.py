@@ -27,13 +27,20 @@ instead of re-implemented inside each:
   incident records ship as their validated ``flight`` field);
 - :mod:`apex_tpu.obs.fleet` — fleet-level registry merging (counter
   sums, bucket-union histogram quantiles, per-replica gauge tables) —
-  the ONE implementation ``bench.py`` and the serving tools share.
+  the ONE implementation ``bench.py`` and the serving tools share;
+- :mod:`apex_tpu.obs.slo` — declarative SLO objectives over the live
+  registry (decode p99, spec acceptance, block utilization) with
+  windowed burn-rate evaluation riding the lag-resolved boundary —
+  zero new host syncs; consumed by
+  :class:`apex_tpu.serve.DisaggRouter` admission (a violating replica
+  loses eligibility) and recorded into the SCENARIO / chaos-incident
+  artifacts.
 
 See ``docs/source/observability.rst`` for the metric catalog, the
 lag-resolution contract, and the span naming convention.
 """
 
-from apex_tpu.obs import fleet, xplane
+from apex_tpu.obs import fleet, slo, xplane
 from apex_tpu.obs.flight import FlightRecorder
 from apex_tpu.obs.metrics import (
     Counter,
@@ -48,6 +55,7 @@ from apex_tpu.obs.metrics import (
     instrument_step,
 )
 from apex_tpu.obs.reqtrace import EVENT_KINDS, RequestTracer
+from apex_tpu.obs.slo import SLObjective, SLOEvaluator, serve_objectives
 from apex_tpu.obs.spans import current_path, span, traced_span
 
 __all__ = [
@@ -55,5 +63,6 @@ __all__ = [
     "counter", "gauge", "histogram", "get_registry", "instrument_step",
     "span", "current_path", "traced_span",
     "EVENT_KINDS", "FlightRecorder", "RequestTracer",
-    "fleet", "xplane",
+    "SLObjective", "SLOEvaluator", "serve_objectives",
+    "fleet", "slo", "xplane",
 ]

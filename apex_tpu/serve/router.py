@@ -98,6 +98,14 @@ class RouterConfig:
     #: field).  ``None`` = no artifact; the flight ring records either
     #: way.
     incident_path: Optional[str] = None
+    #: declarative SLO objectives (a tuple of
+    #: :class:`apex_tpu.obs.slo.SLObjective`) evaluated per replica
+    #: over its OWN registry at every fleet step boundary — an
+    #: SLO-violating replica loses admission ELIGIBILITY (the
+    #: gauge-ranking hook, objective-driven) until its windowed burn
+    #: rate recovers; insufficient windows never de-rank a fresh
+    #: replica.  ``None`` = ranking only, no objectives.
+    slo: Optional[tuple] = None
 
     def __post_init__(self):
         if self.transfer not in ("ship", "recompute"):
@@ -385,6 +393,24 @@ class DisaggRouter:
                 f"replica {i} decode-step p99 (from its own "
                 f"serve_decode_step_seconds histogram)")
             for i in range(len(self.replicas))]
+        # -- SLO admission (apex_tpu.obs.slo): one evaluator per
+        # replica over its OWN registry, judged at the same boundary
+        # _record_metrics already owns — resolved host state only,
+        # zero new host syncs on any replica's compiled step
+        self.slo_evals = None
+        self._m_rep_slo = []
+        if self.rcfg.slo:
+            from apex_tpu.obs.slo import SLOEvaluator
+            self.slo_evals = [SLOEvaluator(rep.eng.metrics,
+                                           self.rcfg.slo)
+                              for rep in self.replicas]
+            self._m_rep_slo = [
+                self.metrics.gauge(
+                    f"serve_replica{i}_slo_ok",
+                    f"replica {i} SLO eligibility (1 = no objective "
+                    f"violated in its window; 0 = de-ranked from "
+                    f"admission)")
+                for i in range(len(self.replicas))]
 
     # -- submission ----------------------------------------------------
 
@@ -415,12 +441,20 @@ class DisaggRouter:
         admission bar; ranked by (outstanding work, utilization,
         decode p99)."""
         scored = [(r.load(), r) for r in self.replicas
-                  if r.can_admit(req)]
+                  if r.can_admit(req) and not self._slo_violating(r)]
         eligible = [(load, r) for load, r in scored
                     if load[1] < self.rcfg.admit_block_util]
         if not eligible:
             return None
         return min(eligible, key=lambda lr: lr[0])[1]
+
+    def _slo_violating(self, rep: DecodeReplica) -> bool:
+        """True when the replica's LAST boundary evaluation has a
+        violated objective — it keeps decoding what it holds, but
+        takes no new admissions until the window recovers."""
+        if self.slo_evals is None:
+            return False
+        return self.slo_evals[rep.index].violated()
 
     def _route_one(self) -> bool:
         """Route the head-of-queue request; False = held (admission
@@ -480,7 +514,20 @@ class DisaggRouter:
                 reg.gauge("serve_block_utilization").value)
             p99 = rep.p99()
             self._m_rep_p99[i].set(0.0 if math.isnan(p99) else p99)
+            if self.slo_evals is not None and rep.alive:
+                self.slo_evals[i].evaluate()
+                self._m_rep_slo[i].set(
+                    0.0 if self.slo_evals[i].violated() else 1.0)
         self.metrics.tick()
+
+    def slo_summary(self) -> "Optional[dict]":
+        """Per-replica SLO verdicts from the last boundary (the block
+        the serving tools record into their artifacts); ``None`` when
+        no objectives are configured."""
+        if self.slo_evals is None:
+            return None
+        return {f"replica{i}": ev.summary()
+                for i, ev in enumerate(self.slo_evals)}
 
     def idle(self) -> bool:
         return not self.queue and all(r.idle() for r in self.replicas)

@@ -887,3 +887,143 @@ def test_repo_trace_validates():
     assert doc["gate"]["ok"] is True
     assert doc["chaos"]["killed"] and doc["chaos"]["rerouted"]
     assert doc["config"]["topology"]["n_devices"] >= 16
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 14: recorded-variance + perf-timeline artifacts are gate memory
+# ---------------------------------------------------------------------------
+
+def _valid_variance():
+    vals = [1.0, 1.1, 0.9, 1.05, 0.95]
+    mean = sum(vals) / len(vals)
+    std = (sum((v - mean) ** 2 for v in vals) / (len(vals) - 1)) ** 0.5
+    return {
+        "platform": "tpu", "device_kind": "v5e", "tiny": False,
+        "round": 7,
+        "entries": {"kernel:fused_adam": {
+            "metric": "ms_per_step", "n": 5, "values": vals,
+            "mean": round(mean, 6), "min": 0.9, "max": 1.1,
+            "std": round(std, 6),
+            "rel_spread": round((1.1 - 0.9) / mean, 4)}},
+    }
+
+
+def test_committed_variance_validated_against_schema(tmp_repo):
+    _analysis_module(tmp_repo, "variance")
+    (tmp_repo / "BENCH_VARIANCE_r07.json").write_text('{"tiny": 1}')
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "bad variance")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("BENCH_VARIANCE_r07.json" in p
+               for p in verdict["invalid_variances"])
+    assert gate_hygiene.main(["--repo", str(tmp_repo)]) == 1
+
+
+def test_variance_summary_must_derive_from_samples(tmp_repo):
+    """A typed-in spread wide enough to excuse a floor drop is
+    rejected: mean/std/rel_spread must re-derive from the recorded
+    values."""
+    _analysis_module(tmp_repo, "variance")
+    doc = _valid_variance()
+    doc["entries"]["kernel:fused_adam"]["rel_spread"] = 0.9
+    (tmp_repo / "BENCH_VARIANCE_r08.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "typed-in spread")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert any("CONTRADICTORY" in p and "rel_spread" in p
+               for p in verdict["invalid_variances"])
+
+
+def test_valid_variance_passes_and_untracked_fails(tmp_repo):
+    _analysis_module(tmp_repo, "variance")
+    (tmp_repo / "BENCH_VARIANCE_r09.json").write_text(
+        json.dumps(_valid_variance()))
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]        # parked-but-untracked
+    assert verdict["untracked"] == ["BENCH_VARIANCE_r09.json"]
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "variance round")
+    assert gate_hygiene.check(str(tmp_repo))["ok"]
+
+
+def _valid_timeline(tmp_repo):
+    """A minimal internally-consistent timeline covering the tmp
+    repo's committed round artifacts (none beyond what the caller
+    adds)."""
+    coverage = {}
+    series = {}
+    sys.path.insert(0, str(REPO))
+    from apex_tpu.analysis import timeline as tl
+    for name in sorted(p.name for p in tmp_repo.glob("*_r*.json")):
+        parsed = tl.parse_artifact_name(name)
+        if parsed is None or parsed[0] == "TIMELINE":
+            continue
+        coverage.setdefault(parsed[0],
+                            {"files": [], "rows": 0})["files"].append(
+            name)
+    series["BENCH|c|tok_s"] = {
+        "family": "BENCH", "config": "c", "metric": "tok_s",
+        "points": [{"round": 1, "value": 100.0, "commit": None}]}
+    return {"round": 1, "head": None,
+            "bands": {"default": 0.03, "per_series": {}},
+            "series": series, "regressions": [],
+            "coverage": coverage or {"BENCH": {"files": [],
+                                               "rows": 0}},
+            "gate": {"regressions": 0, "ok": True}}
+
+
+def test_committed_timeline_validated_against_schema(tmp_repo):
+    _analysis_module(tmp_repo, "timeline")
+    (tmp_repo / "TIMELINE_r07.json").write_text('{"round": "x"}')
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "bad timeline")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("TIMELINE_r07.json" in p
+               for p in verdict["invalid_timelines"])
+
+
+def test_newest_timeline_held_to_coverage_completeness(tmp_repo):
+    """The staleness lint: a new committed round artifact the newest
+    timeline never ingested fails hygiene — the timeline must be
+    regenerated in the same round that adds gate artifacts."""
+    _analysis_module(tmp_repo, "timeline")
+    doc = _valid_timeline(tmp_repo)
+    (tmp_repo / "TIMELINE_r08.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "timeline round")
+    assert gate_hygiene.check(str(tmp_repo))["ok"]
+    # a new artifact lands without a timeline refresh -> STALE
+    (tmp_repo / "KERNELBENCH_r33.json").write_text("{}")
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "new round artifact")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("STALE" in p and "KERNELBENCH_r33" in p
+               for p in verdict["invalid_timelines"])
+    # refreshing the timeline restores green (only the NEWEST round
+    # is held to the checkout; the old round stays internally valid)
+    doc2 = _valid_timeline(tmp_repo)
+    doc2["round"] = 9
+    (tmp_repo / "TIMELINE_r09.json").write_text(json.dumps(doc2))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "refreshed timeline")
+    assert gate_hygiene.check(str(tmp_repo))["ok"]
+
+
+def test_repo_variance_and_timeline_validate():
+    """The committed BENCH_VARIANCE_r01 + TIMELINE_r01 are the
+    schemas' reference instances: valid against this checkout, the
+    timeline covering every committed family, its regression table
+    carrying the two known tpu-heads drops."""
+    assert gate_hygiene._validate_variances(str(REPO)) == []
+    assert gate_hygiene._validate_timelines(str(REPO)) == []
+    arts = sorted(REPO.glob("TIMELINE_r*.json"))
+    assert arts, "the timeline gate artifact must be committed"
+    doc = json.loads(arts[-1].read_text())
+    assert {r["series"] for r in doc["regressions"]} == {
+        "BENCH|gpt_small_tpu_heads_o2|tok_s",
+        "BENCH|bert_large_tpu_heads_lamb_o2|seq_s"}
+    assert sorted(REPO.glob("BENCH_VARIANCE_r*.json")), \
+        "the variance gate artifact must be committed"

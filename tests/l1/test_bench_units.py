@@ -697,3 +697,130 @@ def test_bench_serve_disagg_tiny_cpu():
         d for rep in r["topology"]["decode"] for d in rep]
     assert len(flat) == len(set(flat))
     assert r["p99_ms"] >= r["p50_ms"] > 0
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 14: statistical floor bands derived from recorded variance
+# ---------------------------------------------------------------------------
+
+def _variance_doc(entries, tiny=False):
+    return {"platform": "tpu", "tiny": tiny, "entries": entries}
+
+
+def test_derive_floor_bands_formula_and_ratchet():
+    """floor = mean - k*std where evidence qualifies; hand floors are
+    the frozen fallback; the no-ratchet-down rule applies to DERIVED
+    candidates too (a candidate below the hand floor beyond the
+    recorded spread is refused)."""
+    hand = {"cfg": 0.40}
+    # no artifact / tiny artifact / missing entry / OFF-CHIP artifact
+    # (a full-size CPU run says nothing about TPU floors): hand stands
+    cpu_doc = dict(_variance_doc({"config:cfg": {
+        "mfu": {"n": 9, "mean": 0.10, "std": 0.01},
+        "rel_spread": 0.9}}), platform="cpu")
+    for doc in (None, _variance_doc({}, tiny=True), _variance_doc({}),
+                cpu_doc):
+        bands = bench.derive_floor_bands(hand, doc, kind="config",
+                                         stat="mfu")
+        assert bands["cfg"] == {"floor": 0.40, "source": "hand",
+                                "provisional": False}
+    # qualifying entry ABOVE the hand floor: derived, ratchets up
+    doc = _variance_doc({"config:cfg": {
+        "mfu": {"n": 5, "mean": 0.46, "std": 0.01},
+        "rel_spread": 0.05}})
+    rec = bench.derive_floor_bands(hand, doc, kind="config",
+                                   stat="mfu")["cfg"]
+    assert rec["source"] == "derived" and rec["floor"] == 0.44
+    # candidate below the hand floor but INSIDE the recorded spread:
+    # the statistical floor may honestly sit lower
+    doc = _variance_doc({"config:cfg": {
+        "mfu": {"n": 5, "mean": 0.40, "std": 0.005,
+                "rel_spread": 0.06}, "rel_spread": 0.06}})
+    rec = bench.derive_floor_bands(hand, doc, kind="config",
+                                   stat="mfu")["cfg"]
+    assert rec["source"] == "derived" and rec["floor"] == 0.39
+    # candidate far below beyond the spread: REFUSED (no-ratchet-down)
+    doc = _variance_doc({"config:cfg": {
+        "mfu": {"n": 5, "mean": 0.30, "std": 0.01,
+                "rel_spread": 0.02}, "rel_spread": 0.02}})
+    rec = bench.derive_floor_bands(hand, doc, kind="config",
+                                   stat="mfu")["cfg"]
+    assert rec["source"] == "hand" and rec["floor"] == 0.40
+    assert "no-ratchet-down" in rec["reason"]
+    # insufficient samples: hand floor, reason recorded
+    doc = _variance_doc({"config:cfg": {
+        "mfu": {"n": 2, "mean": 0.46, "std": 0.01}}})
+    rec = bench.derive_floor_bands(hand, doc, kind="config",
+                                   stat="mfu")["cfg"]
+    assert rec["source"] == "hand" and "insufficient" in rec["reason"]
+    # the drop is judged by the spread of the SAME statistic the
+    # floor gates: a wide spread on a DIFFERENT metric (here the
+    # rate) is not evidence about hbm_frac — refused
+    doc = _variance_doc({"config:cfg": {
+        "rel_spread": 0.50,        # wide rate spread
+        "hbm_frac": {"n": 5, "mean": 0.30, "std": 0.005,
+                     "rel_spread": 0.02}}})
+    rec = bench.derive_floor_bands(hand, doc, kind="config",
+                                   stat="hbm_frac")["cfg"]
+    assert rec["source"] == "hand" and "no-ratchet-down" in \
+        rec["reason"]
+    assert not bench.floor_change_allowed("cfg", 0.40, 0.30, doc,
+                                          stat="hbm_frac")
+    assert bench.floor_change_allowed("cfg", 0.40, 0.395, doc,
+                                      stat="hbm_frac")
+
+
+def test_frozen_fallback_no_floor_loosened_by_committed_artifact():
+    """The acceptance bar: with the COMMITTED BENCH_VARIANCE_r*.json
+    (a tiny CPU smoke until a chip round lands), every effective floor
+    equals today's hand value exactly — consulting the artifact can
+    never loosen a gate silently."""
+    kfloors = _kernel_floors()
+    for table, kind, stat in (
+            (bench.MFU_FLOORS, "config", "mfu"),
+            (bench.DECODE_FLOORS, "config", "hbm_frac"),
+            (kfloors, "kernel", "roofline_frac")):
+        eff, bands = bench.effective_floors(table, str(REPO),
+                                            kind=kind, stat=stat)
+        assert eff == dict(table), (kind, eff)
+        assert all(b["source"] == "hand" for b in bands.values())
+
+
+def test_gates_consult_derived_bands(monkeypatch, tmp_path):
+    """check_mfu_floors/check_decode_floors with a search_dir apply
+    the DERIVED floor (here: ratcheted up by synthetic evidence) and
+    record its source — the 'demonstrably consult' bar."""
+    import json as _json
+    doc = _variance_doc({"config:gpt_small_o2": {
+        "mfu": {"n": 6, "mean": 0.50, "std": 0.01},
+        "rel_spread": 0.03}})
+    (tmp_path / "BENCH_VARIANCE_r05.json").write_text(_json.dumps(doc))
+    # measured 0.45: passes the hand floor 0.41, FAILS the derived
+    # 0.48 gate (0.456) — the consultation is observable
+    out = bench.check_mfu_floors({"gpt_small_o2": {"mfu": 0.45}},
+                                 search_dir=str(tmp_path))
+    assert out["checked"]["gpt_small_o2"]["source"] == "derived"
+    assert out["checked"]["gpt_small_o2"]["floor"] == 0.48
+    assert out["violations"] == ["gpt_small_o2"]
+    # without the artifact the same measurement passes the hand floor
+    ok = bench.check_mfu_floors({"gpt_small_o2": {"mfu": 0.45}})
+    assert ok["ok"] and ok["checked"]["gpt_small_o2"]["source"] == "hand"
+
+
+def test_kv8_floor_marked_provisional_in_gate_record():
+    """The CPU-smoke-seeded kv8 entry is reported as UNMEASURED: the
+    decode gate record and check_floor_calibration both name it
+    provisional instead of passing it off as a floor."""
+    assert "gpt_small_tpu_decode_kv8" in bench.PROVISIONAL_FLOORS
+    out = bench.check_decode_floors(
+        {"gpt_small_tpu_decode_kv8": {"hbm_frac": 0.002}})
+    assert out["provisional"] == ["gpt_small_tpu_decode_kv8"]
+    assert out["checked"]["gpt_small_tpu_decode_kv8"]["provisional"] \
+        is True
+    cal = bench.check_floor_calibration(str(REPO))
+    assert cal["ok"], cal
+    assert "gpt_small_tpu_decode_kv8" in cal["provisional_floors"]
+    # measured floors are NOT provisional
+    ok = bench.check_decode_floors(
+        {"gpt_small_tpu_decode_b8": {"hbm_frac": 0.43}})
+    assert "provisional" not in ok["checked"]["gpt_small_tpu_decode_b8"]

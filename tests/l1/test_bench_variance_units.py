@@ -58,3 +58,46 @@ def test_unknown_names_recorded_not_fatal(tmp_path):
     doc = json.loads(out.read_text())
     assert doc["entries"]["kernel:no_such_kernel"]["error"] \
         == "unknown kernel"
+
+
+def test_stats_carry_std_and_validate():
+    """The extended schema statistic: sample std recorded next to the
+    spread, and every summary re-derivable from the recorded values
+    (the variance schema's contradiction bar)."""
+    s = bv._stats([1.0, 1.1, 0.9, 1.05, 0.95])
+    assert s["std"] > 0
+    from apex_tpu.analysis.variance import validate_variance
+    doc = {"platform": "cpu", "tiny": False,
+           "entries": {"kernel:k": {"metric": "ms_per_step", **s}}}
+    assert validate_variance(doc) == []
+    lied = dict(doc, entries={"kernel:k": {**s, "std": 9.0,
+                                           "metric": "ms"}})
+    assert any("std" in p for p in validate_variance(lied))
+
+
+def test_round_numbered_artifact_schema_validated(tmp_path):
+    """--round N emits BENCH_VARIANCE_rNN.json, schema-validated
+    before writing, with the roofline_frac sub-stat the kernel floor
+    derivation consumes."""
+    out = tmp_path / "BENCH_VARIANCE_r07.json"
+    rc = bv.main(["--out", str(out), "--round", "7", "--n", "2",
+                  "--tiny", "--kernels", "mt_scale"])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["round"] == 7
+    from apex_tpu.analysis.variance import validate_variance
+    assert validate_variance(doc) == []
+    sub = doc["entries"]["kernel:mt_scale"]["roofline_frac"]
+    assert sub["n"] == 2 and sub["mean"] > 0
+
+
+def test_load_variance_prefers_round_numbered(tmp_path):
+    import bench
+    (tmp_path / "BENCH_VARIANCE.json").write_text(
+        '{"tiny": true, "entries": {}, "legacy": 1}')
+    assert bench.load_variance(str(tmp_path))["legacy"] == 1
+    (tmp_path / "BENCH_VARIANCE_r01.json").write_text(
+        '{"tiny": true, "entries": {}, "round": 1}')
+    (tmp_path / "BENCH_VARIANCE_r02.json").write_text(
+        '{"tiny": true, "entries": {}, "round": 2}')
+    assert bench.load_variance(str(tmp_path))["round"] == 2

@@ -200,3 +200,24 @@ def test_floors_skip_off_tpu(monkeypatch, tmp_path):
     import json as _json
     doc = _json.load(open(out))
     assert doc["floors"]["ok"] and "skipped" in doc["floors"]
+
+
+def test_check_kernel_floors_accepts_derived_override():
+    """bench.py and main() pass variance-derived effective floors;
+    the override is applied verbatim — a ratcheted-up derived floor
+    fails a measurement the hand floor would pass."""
+    measured = {"fused_adam": {"roofline_frac": 0.32}}
+    assert kb.check_kernel_floors(measured)["ok"]          # hand 0.30
+    out = kb.check_kernel_floors(measured,
+                                 floors={"fused_adam": 0.36})
+    assert not out["ok"] and out["violations"] == ["fused_adam"]
+    assert out["checked"]["fused_adam"]["floor"] == 0.36
+
+
+def test_effective_kernel_floors_frozen_fallback():
+    """With the committed (tiny) variance artifact, the effective
+    kernel floors equal the published hand table — nothing loosened —
+    and every source records 'hand'."""
+    floors, bands = kb.effective_kernel_floors()
+    assert floors == dict(kb.KERNEL_FLOORS)
+    assert all(b["source"] == "hand" for b in bands.values())

@@ -20,10 +20,22 @@ Two entry kinds:
   set across the round's days.
 
 The artifact is a gate baseline: ``tools/gate_hygiene.py`` fails tier-1
-when it is modified-but-uncommitted.
+when it is modified-but-uncommitted, and round-numbered artifacts
+(``--round N`` → ``BENCH_VARIANCE_rNN.json``) are additionally
+schema-validated (``apex_tpu/analysis/variance.py``: recorded
+mean/min/max/std/rel_spread must agree with the recorded samples — a
+spread that excuses a floor drop must be derivable, not typed in).
+
+Each entry records ``std`` (sample standard deviation) next to the
+spread, plus the gate statistics the floors actually ride: kernels
+carry a ``roofline_frac`` sub-stat block, configs an ``mfu`` and (for
+decode configs) an ``hbm_frac`` block — so
+``bench.derive_floor_bands()`` computes ``floor = mean − k·std`` on
+exactly the gated statistic, and ``tools/perf_timeline.py`` reads
+per-series band widths from the same entries.
 
 Usage: python tools/bench_variance.py [--out BENCH_VARIANCE.json]
-       [--n 5] [--kernels fused_adam,mt_scale,...]
+       [--round N] [--n 5] [--kernels fused_adam,mt_scale,...]
        [--configs resnet50_o2,gpt_small_o2] [--tiny]
 """
 
@@ -42,13 +54,25 @@ import jax  # noqa: E402
 
 
 def _stats(values):
+    # summarize the ROUNDED samples the record actually stores, so the
+    # schema validator (apex_tpu/analysis/variance.py) can re-derive
+    # every summary statistic from the recorded values exactly.
+    # SIGNIFICANT digits, not fixed decimals: a sub-microsecond tiny-
+    # smoke timing must not round to 0.0 and destroy the stats block
+    values = [float(f"{v:.6g}") for v in values]
     mean = sum(values) / len(values)
+    # sample standard deviation: the "spread" in the derived-floor
+    # formula floor = mean - k*std (0.0 for a single sample — which
+    # derive_floor_bands refuses anyway via its min-samples rule)
+    std = (sum((v - mean) ** 2 for v in values)
+           / (len(values) - 1)) ** 0.5 if len(values) > 1 else 0.0
     return {
         "n": len(values),
-        "values": [round(v, 6) for v in values],
-        "mean": round(mean, 6),
-        "min": round(min(values), 6),
-        "max": round(max(values), 6),
+        "values": values,
+        "mean": float(f"{mean:.6g}"),
+        "min": min(values),
+        "max": max(values),
+        "std": float(f"{std:.6g}"),
         # the band-width statistic: worst-case same-artifact swing
         "rel_spread": round((max(values) - min(values)) / mean, 4)
         if mean else None,
@@ -71,10 +95,21 @@ def measure_kernels(names, n: int, tiny: bool) -> dict:
             continue
         try:
             fn, args, iters = specs[name]
-            build, _, geom = fn(*args)
+            build, nbytes, geom = fn(*args)
             vals = [kb._time_scan(build, iters) * 1e3 for _ in range(n)]
-            entries[f"kernel:{name}"] = {"metric": "ms_per_step",
-                                         "geometry": geom, **_stats(vals)}
+            entry = {"metric": "ms_per_step", "geometry": geom,
+                     **_stats(vals)}
+            # the GATED statistic: per-repeat roofline fraction (the
+            # KERNEL_FLOORS unit), so derive_floor_bands computes
+            # mean - k*std on exactly what the floor gates.  A repeat
+            # whose difference quotient collapsed to <= 0 (tiny-smoke
+            # noise) has no meaningful fraction — skip the block
+            # rather than divide by it
+            if all(ms > 0 for ms in vals):
+                bw = kb._hbm_peak()
+                entry["roofline_frac"] = _stats(
+                    [nbytes / (ms * 1e-3) / bw for ms in vals])
+            entries[f"kernel:{name}"] = entry
         except Exception as e:  # noqa: BLE001 - per-entry isolation
             entries[f"kernel:{name}"] = {
                 "error": f"{type(e).__name__}: {e}"[:300]}
@@ -125,22 +160,45 @@ def measure_configs(names, n: int, tiny: bool) -> dict:
         "bert_large_tpu_heads_lamb_o2": lambda: bench.bench_bert(
             tpu_heads=True, peak=peak, **bert),
     }
+    # the DECODE_FLOORS configs: hbm_frac is their gated statistic, so
+    # a chip round can justify (or refuse) a decode-floor move with
+    # the same recorded-variance rule the MFU floors ride — including
+    # the kv8 config whose CPU-seeded placeholder floor stays
+    # provisional until an entry lands here
+    if on_tpu:
+        dec = dict(batch=8, prefill=2048, new_tokens=256, warmup=1,
+                   iters=4, tiny=False)
+    else:
+        dec = dict(batch=2, prefill=16, new_tokens=8, warmup=0,
+                   iters=1, tiny=True)
+    fns.update({
+        "gpt_small_tpu_decode_b1": lambda: bench.bench_generate(
+            peak=peak, **dict(dec, batch=1)),
+        "gpt_small_tpu_decode_b8": lambda: bench.bench_generate(
+            peak=peak, **dec),
+        "gpt_small_tpu_decode_kv8": lambda: bench.bench_generate(
+            peak=peak, kv_dtype="int8", **dec),
+    })
     entries = {}
     for name in names:
         if name not in fns:
             entries[f"config:{name}"] = {"error": "unknown config"}
             continue
         try:
-            rates, mfus, key = [], [], None
+            rates, mfus, fracs, key = [], [], [], None
             for _ in range(n):
                 res = fns[name]()
                 key = next(k for k in bench.RATE_KEYS if res.get(k))
                 rates.append(float(res[key]))
                 if res.get("mfu"):
                     mfus.append(float(res["mfu"]))
+                if isinstance(res.get("hbm_frac"), (int, float)):
+                    fracs.append(float(res["hbm_frac"]))
             entries[f"config:{name}"] = {"metric": key, **_stats(rates)}
             if mfus:
                 entries[f"config:{name}"]["mfu"] = _stats(mfus)
+            if fracs:
+                entries[f"config:{name}"]["hbm_frac"] = _stats(fracs)
         except Exception as e:  # noqa: BLE001 - per-entry isolation
             entries[f"config:{name}"] = {
                 "error": f"{type(e).__name__}: {e}"[:300]}
@@ -149,7 +207,12 @@ def measure_configs(names, n: int, tiny: bool) -> dict:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--out", default=str(REPO / "BENCH_VARIANCE.json"))
+    ap.add_argument("--out", default=None,
+                    help="output path (default BENCH_VARIANCE.json, or "
+                         "BENCH_VARIANCE_rNN.json with --round)")
+    ap.add_argument("--round", type=int, default=None,
+                    help="emit the round-numbered, schema-validated "
+                         "gate artifact BENCH_VARIANCE_rNN.json")
     ap.add_argument("--n", type=int, default=5)
     ap.add_argument("--kernels", default="fused_adam,lamb_stage1,mt_scale")
     ap.add_argument("--configs", default="",
@@ -158,6 +221,10 @@ def main(argv=None) -> int:
     ap.add_argument("--tiny", action="store_true",
                     help="tiny shapes (CPU smoke; spreads meaningless)")
     args = ap.parse_args(argv)
+    if args.out is None:
+        args.out = str(REPO / (f"BENCH_VARIANCE_r{args.round:02d}.json"
+                               if args.round is not None
+                               else "BENCH_VARIANCE.json"))
 
     entries = {}
     if args.kernels:
@@ -172,7 +239,17 @@ def main(argv=None) -> int:
         "tiny": args.tiny,
         "entries": entries,
     }
-    Path(args.out).write_text(json.dumps(result, indent=1))
+    if args.round is not None:
+        result["round"] = args.round
+        # a round-numbered artifact is gate memory: refuse to write an
+        # invalid one (the same pre-flight serve_scenarios runs)
+        from apex_tpu.analysis.variance import validate_variance
+        problems = validate_variance(result)
+        if problems:
+            print(f"bench_variance: REFUSING schema-invalid artifact: "
+                  f"{problems}", file=sys.stderr)
+            return 1
+    Path(args.out).write_text(json.dumps(result, indent=1) + "\n")
     print(json.dumps(result))
     # errors are per-entry records, not exit failures: partial variance
     # evidence beats none after the chip time is spent

@@ -231,8 +231,31 @@ def main(argv=None) -> int:
                                         on_commit=injector.on_commit)
 
     from apex_tpu.obs.flight import FlightRecorder
+    from apex_tpu.obs.metrics import Registry
+    from apex_tpu.obs.slo import SLObjective, SLOEvaluator
 
     amp_obj, step_fn, state, batch_fn = build_workload(args.seed)
+    # SLO verdicts over the loop's own registry (apex_tpu.obs.slo):
+    # the overflow-rate objective judges the storm's damage (a clean
+    # run overflows ~never; a nan storm burns the budget), the
+    # watchdog-margin gauge the proximity to a wedge.  The evaluator
+    # reads resolved host state only; the first evaluate() seeds the
+    # window base at zero.
+    registry = Registry()
+    registry.counter("train_steps_total")
+    registry.counter("train_overflows_total")
+    registry.gauge("train_watchdog_margin_s").set(args.watchdog)
+    slo_ev = SLOEvaluator(registry, (
+        SLObjective(name="overflow_rate", kind="ratio",
+                    ratio_num="train_overflows_total",
+                    ratio_den="train_steps_total", op="le",
+                    threshold=0.25, window=1,
+                    min_count=min(8, args.steps)),
+        SLObjective(name="watchdog_margin", kind="gauge",
+                    metric="train_watchdog_margin_s", op="ge",
+                    threshold=0.0, window=1, min_count=1),
+    ))
+    slo_ev.evaluate()
     restarts = 0
     status, summary = "completed", "chaos run completed"
     result = None
@@ -253,7 +276,7 @@ def main(argv=None) -> int:
                 result = run_resilient(
                     step_fn, state, batch_fn, args.steps, amp_obj=amp_obj,
                     manager=manager, config=cfg, injector=injector,
-                    flight=flight)
+                    registry=registry, flight=flight)
             except SimulatedPreemption as e:
                 # scheduler restart: fresh process state, restore from the
                 # last GOOD (checksum-verified) snapshot, resume
@@ -294,10 +317,23 @@ def main(argv=None) -> int:
                          "steps_completed": result.steps_completed,
                          "rewinds": result.rewinds})
 
+    # the run's SLO verdict: one end-of-run evaluation over the whole
+    # window (base = the pre-run snapshot) — recorded into the
+    # incident so the chaos artifact carries an objective-level story
+    # next to the event-level flight tail
+    registry.flush()
+    slo_verdict = None
+    try:
+        slo_ev.evaluate()
+        slo_verdict = slo_ev.summary()
+    except Exception as e:  # noqa: BLE001 - forensics must not die
+        slo_verdict = {"error": f"{type(e).__name__}: {e}"[:200]}
+
     extra = {"artifact": "chaos-run fault-injection record",
              "harness": "tools/chaos_run.py -> apex_tpu.resilience",
              "faults": list(args.faults), "restarts": restarts,
              "checkpoint_dir": ckpt_dir,
+             "slo": slo_verdict,
              "flight": flight.dump()}
     if args.overhead:
         extra["overhead"] = measure_overhead(seed=args.seed)
@@ -313,6 +349,7 @@ def main(argv=None) -> int:
         print(f"chaos_run: flight-recorder tail incomplete: "
               f"{flight_problems}", file=sys.stderr)
     print(json.dumps({"status": rec["status"], "out": args.out,
+                      "slo_ok": (slo_verdict or {}).get("ok"),
                       "restarts": restarts,
                       "rewinds": getattr(result, "rewinds", None),
                       "final_loss": final_loss,

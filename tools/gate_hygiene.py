@@ -80,7 +80,21 @@ This check fails (exit 1) when
   forensic record is gate memory like every other artifact.  The
   incident schema's grown optional ``flight`` field (the
   flight-recorder tail) is validated through the same committed
-  ``INCIDENT_r*.json`` check above.
+  ``INCIDENT_r*.json`` check above, or
+- a committed ``BENCH_VARIANCE_r*.json`` does not validate against
+  the variance schema (``apex_tpu/analysis/variance.py``: recorded
+  mean/min/max/std/rel_spread must re-derive from the recorded
+  samples — a spread wide enough to excuse a floor drop cannot be
+  typed in) — the statistics every derived floor and band width ride
+  are gate memory like the floors themselves, or
+- a committed ``TIMELINE_r*.json`` does not validate against the
+  timeline schema (``apex_tpu/analysis/timeline.py``: every
+  regression row must cite a series whose recorded points actually
+  cross its band, no gated series crossing its band may lack a row,
+  and ``gate.ok`` must re-derive from the table), or the NEWEST
+  committed timeline's coverage table is missing ANY committed
+  round-numbered artifact — the cross-round view must never silently
+  go stale as new families/rounds land.
 
 It is wired into tier-1 (``tests/l0/test_gate_hygiene.py``), so a round
 cannot go green with dirty gate memory.  Best-effort on the VCS side:
@@ -110,13 +124,14 @@ REQUIRED = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json")
 #: gate memory the moment it exists; incident records are round
 #: evidence the same way).
 PATTERNS = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json",
-            "BENCH_VARIANCE.json", "KERNELBENCH_r*.json",
+            "BENCH_VARIANCE.json", "BENCH_VARIANCE_r*.json",
+            "KERNELBENCH_r*.json",
             "BENCH_r*.json", "INCIDENT_r*.json", "MEMLINT_r*.json",
             "PRECLINT_r*.json", "DECODE_DECOMPOSE_r*.json",
             "OBS_r*.json", "DECODE_PROFILE_r*.json",
             "CONVERGENCE_r*.json", "EXPORT_r*.json",
             "SERVE_DISAGG_r*.json", "SCENARIO_r*.json",
-            "TRACE_r*.json")
+            "TRACE_r*.json", "TIMELINE_r*.json")
 
 #: Round-numbered incident artifacts additionally get schema-validated.
 INCIDENT_PATTERN = "INCIDENT_r*.json"
@@ -148,8 +163,15 @@ SERVE_DISAGG_PATTERN = "SERVE_DISAGG_r*.json"
 #: ... and the serve scenario-matrix gate artifacts ...
 SCENARIO_PATTERN = "SCENARIO_r*.json"
 
-#: ... and the fleet request-trace artifacts.
+#: ... and the fleet request-trace artifacts ...
 TRACE_PATTERN = "TRACE_r*.json"
+
+#: ... and the recorded-variance artifacts (the statistics under the
+#: derived floors) ...
+VARIANCE_PATTERN = "BENCH_VARIANCE_r*.json"
+
+#: ... and the longitudinal perf-timeline artifacts.
+TIMELINE_PATTERN = "TIMELINE_r*.json"
 
 
 def _load_by_path(repo: str, *rel: str):
@@ -323,6 +345,42 @@ def _validate_traces(repo: str) -> "list[str]":
     return problems
 
 
+def _validate_variances(repo: str) -> "list[str]":
+    """Schema problems over every present BENCH_VARIANCE_r*.json, as
+    ``path: problem`` strings (``apex_tpu/analysis/variance.py``)."""
+    schema = _load_by_path(repo, "apex_tpu", "analysis", "variance.py")
+    if schema is None:
+        return []
+    problems = []
+    for p in sorted(Path(repo).glob(VARIANCE_PATTERN)):
+        for msg in schema.validate_variance_file(str(p)):
+            problems.append(f"{p.name}: {msg}")
+    return problems
+
+
+def _validate_timelines(repo: str) -> "list[str]":
+    """Schema problems over every present TIMELINE_r*.json, as
+    ``path: problem`` strings (``apex_tpu/analysis/timeline.py``).
+    Only the NEWEST round is held to coverage-completeness against
+    the checkout's committed artifacts (older rounds were complete
+    when written; they stay valid on internal consistency)."""
+    schema = _load_by_path(repo, "apex_tpu", "analysis", "timeline.py")
+    if schema is None:
+        return []
+    rounds = []
+    for p in sorted(Path(repo).glob(TIMELINE_PATTERN)):
+        parsed = schema.parse_artifact_name(p.name)
+        rounds.append((parsed[1] if parsed else -1, p))
+    rounds.sort()
+    problems = []
+    for i, (_, p) in enumerate(rounds):
+        newest = i == len(rounds) - 1
+        for msg in schema.validate_timeline_file(
+                str(p), repo_dir=repo if newest else None):
+            problems.append(f"{p.name}: {msg}")
+    return problems
+
+
 def _git(repo: str, *args: str) -> "str | None":
     """stdout of a git command, or None when git/The repo is unavailable
     (the best-effort contract)."""
@@ -351,7 +409,8 @@ def check(repo: str = str(REPO)) -> dict:
                 "invalid_decomposes": [], "invalid_obs": [],
                 "invalid_profiles": [], "invalid_convergences": [],
                 "invalid_exports": [], "invalid_serve_disaggs": [],
-                "invalid_scenarios": [], "invalid_traces": []}
+                "invalid_scenarios": [], "invalid_traces": [],
+                "invalid_variances": [], "invalid_timelines": []}
     tracked = set(tracked_raw.split())
     missing = [f for f in REQUIRED
                if not (Path(repo) / f).exists() or f not in tracked]
@@ -382,11 +441,14 @@ def check(repo: str = str(REPO)) -> dict:
     invalid_disagg = _validate_serve_disaggs(repo)
     invalid_scen = _validate_scenarios(repo)
     invalid_trace = _validate_traces(repo)
+    invalid_var = _validate_variances(repo)
+    invalid_tl = _validate_timelines(repo)
     return {"ok": not (missing or untracked or dirty or invalid
                        or invalid_mem or invalid_prec or invalid_dec
                        or invalid_obs or invalid_prof or invalid_conv
                        or invalid_exp or invalid_disagg
-                       or invalid_scen or invalid_trace),
+                       or invalid_scen or invalid_trace
+                       or invalid_var or invalid_tl),
             "missing": missing, "untracked": untracked, "dirty": dirty,
             "invalid_incidents": invalid,
             "invalid_memlints": invalid_mem,
@@ -398,7 +460,9 @@ def check(repo: str = str(REPO)) -> dict:
             "invalid_exports": invalid_exp,
             "invalid_serve_disaggs": invalid_disagg,
             "invalid_scenarios": invalid_scen,
-            "invalid_traces": invalid_trace}
+            "invalid_traces": invalid_trace,
+            "invalid_variances": invalid_var,
+            "invalid_timelines": invalid_tl}
 
 
 def main(argv=None) -> int:
@@ -426,7 +490,10 @@ def main(argv=None) -> int:
               f"{verdict.get('invalid_serve_disaggs', [])}; invalid "
               f"scenario records {verdict.get('invalid_scenarios', [])}; "
               f"invalid trace records "
-              f"{verdict.get('invalid_traces', [])}",
+              f"{verdict.get('invalid_traces', [])}; invalid variance "
+              f"records {verdict.get('invalid_variances', [])}; "
+              f"invalid/stale timeline records "
+              f"{verdict.get('invalid_timelines', [])}",
               file=sys.stderr)
         return 1
     return 0

@@ -461,9 +461,48 @@ KERNEL_FLOORS = {
 }
 
 
-def check_kernel_floors(kernels: dict) -> dict:
+def effective_kernel_floors(
+        search_dir: "str | None" = None) -> "tuple[dict, dict]":
+    """``({kernel: floor}, bands)`` — KERNEL_FLOORS after consulting
+    the committed ``BENCH_VARIANCE_r*.json`` in ``search_dir``
+    (default: this checkout) through ``bench.derive_floor_bands``
+    (statistical floors where a qualifying ``kernel:<name>`` entry
+    carries a ``roofline_frac`` stats block; the hand table as the
+    frozen fallback, protected by the no-ratchet-down rule).  Falls
+    back to the hand table when bench is unimportable — the gate must
+    never silently disarm."""
+    try:
+        # bench.py may BE the running __main__ (python bench.py):
+        # `import bench` would then re-execute its whole module —
+        # resolve the already-loaded instance first
+        bench = sys.modules.get("bench")
+        if bench is None or not hasattr(bench, "effective_floors"):
+            main_mod = sys.modules.get("__main__")
+            if main_mod is not None and \
+                    hasattr(main_mod, "effective_floors") and \
+                    hasattr(main_mod, "derive_floor_bands"):
+                bench = main_mod
+            else:
+                if str(REPO) not in sys.path:
+                    sys.path.insert(0, str(REPO))
+                import bench
+        floors, bands = bench.effective_floors(
+            KERNEL_FLOORS, search_dir or str(REPO), kind="kernel",
+            stat="roofline_frac")
+        return floors, bands
+    except Exception:  # noqa: BLE001 - hand floors always stand
+        return dict(KERNEL_FLOORS), {
+            n: {"floor": f, "source": "hand", "provisional": False}
+            for n, f in KERNEL_FLOORS.items()}
+
+
+def check_kernel_floors(kernels: dict,
+                        floors: "dict | None" = None) -> dict:
     """Absolute per-kernel efficiency gate: every measured kernel with a
     published floor must hold ``roofline_frac >= floor * (1 - band)``.
+    ``floors`` overrides the hand table (``bench.py`` and ``main``
+    pass the variance-derived effective floors; ``None`` = the
+    published hand values).
 
     A gated kernel PRESENT in the map but errored (no roofline_frac —
     e.g. a geometry change that fails Mosaic compilation) fails the gate
@@ -471,7 +510,8 @@ def check_kernel_floors(kernels: dict) -> dict:
     is the worst regression, and a gate that skips it fails open.
     Kernels absent from the map (partial runs) are merely not judged."""
     checked, violations, errored = {}, [], []
-    for name, floor in KERNEL_FLOORS.items():
+    for name, floor in (floors if floors is not None
+                        else KERNEL_FLOORS).items():
         cur = kernels.get(name)
         if cur is None:
             continue
@@ -566,7 +606,14 @@ def main(argv=None):
     # meaningful against a real HBM (off-chip the interpret-mode timings
     # measure the host), so off-TPU it records skipped and never gates.
     if result["platform"] == "tpu":
-        result["floors"] = check_kernel_floors(result["kernels"])
+        # the gate consults the committed variance artifact: derived
+        # statistical floors where evidence qualifies, the published
+        # hand table otherwise (never looser without evidence)
+        eff, bands = effective_kernel_floors()
+        result["floors"] = check_kernel_floors(result["kernels"],
+                                               floors=eff)
+        result["floors"]["floor_sources"] = {
+            n: b["source"] for n, b in bands.items()}
     else:
         result["floors"] = {
             "ok": True,

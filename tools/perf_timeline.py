@@ -1,0 +1,304 @@
+"""Longitudinal perf timeline: one history over every committed
+artifact family, with statistical regression attribution.
+
+Builds the normalized metric timeline (``apex_tpu/analysis/
+timeline.py``) over EVERY round-numbered artifact committed next to
+``bench.py`` — one registered adapter per schema family; a committed
+``*_r*.json`` family with no adapter is a **lint error** (exit 1), so
+a new gate family cannot land without joining the timeline — and emits
+a schema-valid ``TIMELINE_r*.json`` carrying:
+
+- per-series trajectories, each round's point tagged with the commit
+  that introduced its artifact (``git log --diff-filter=A``);
+- the **regression table**: every gated series (configs carrying
+  ``bench.MFU_FLOORS``/``bench.DECODE_FLOORS`` entries on their rate
+  and ``hbm_frac`` metrics, kernels carrying
+  ``kernel_bench.KERNEL_FLOORS`` on ``roofline_frac``) whose newest
+  value sits below its statistical band — band = the recorded relative
+  spread from the newest committed ``BENCH_VARIANCE_r*.json`` when a
+  non-tiny entry covers the series, else the documented default
+  (``timeline.DEFAULT_BAND``).  Each row names the first round where
+  the value dropped and the **suspect commits** between the two
+  rounds' artifact commits — the gpt −3.2% / bert_lamb −3.6% r04→r05
+  finding (VERDICT r5 weak #1), rediscovered mechanically;
+- the **coverage table** proving every committed family and file was
+  ingested (``tools/gate_hygiene.py`` holds the newest committed
+  timeline to this bar against the checkout, so the timeline can
+  never silently go stale).
+
+Usage: python tools/perf_timeline.py [--emit-json TIMELINE_rN.json]
+       [--repo DIR] [--band 0.03] [--gate] [--max-suspects 30]
+
+``--gate`` exits 2 when the regression table is non-empty (the driver
+round's blocking mode); without it the table is attribution evidence
+and the exit code only covers lint errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+from apex_tpu.analysis import timeline  # noqa: E402
+
+
+def _git(repo: str, *args: str) -> "str | None":
+    try:
+        out = subprocess.run(["git", "-C", repo, *args],
+                             capture_output=True, text=True, timeout=60)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    return out.stdout
+
+
+def added_commit(repo: str, name: str) -> "str | None":
+    """Short hash of the commit that INTRODUCED ``name`` (the round
+    tag's anchor: artifacts are committed once, in the round commit
+    that produced them)."""
+    out = _git(repo, "log", "--diff-filter=A", "--format=%h", "--",
+               name)
+    lines = (out or "").split()
+    return lines[-1] if lines else None
+
+
+def commits_between(repo: str, frm: str, to: str,
+                    limit: int = 30) -> list:
+    """``[{"commit", "subject"}, ...]`` for every commit in
+    ``frm..to`` (oldest first) — the suspect range between two rounds'
+    artifact commits."""
+    out = _git(repo, "log", "--reverse", "--format=%h\x1f%s",
+               f"{frm}..{to}")
+    rows = []
+    for line in (out or "").splitlines():
+        h, _, subject = line.partition("\x1f")
+        if h:
+            rows.append({"commit": h, "subject": subject[:120]})
+    if len(rows) > limit:
+        rows = rows[:limit] + [{"commit": "...",
+                                "subject": f"({len(rows) - limit} "
+                                           f"more omitted)"}]
+    return rows
+
+
+def resolve_commits(repo: str, coverage: dict) -> dict:
+    """``{(family, round): short_hash}`` for every covered artifact."""
+    commits = {}
+    for family, rec in coverage.items():
+        for name in rec.get("files", []):
+            parsed = timeline.parse_artifact_name(name)
+            if parsed is None:
+                continue
+            h = added_commit(repo, name)
+            if h:
+                commits[(family, parsed[1])] = h
+    return commits
+
+
+def gated_series_keys(series: dict,
+                      repo: str) -> "tuple[list, dict, list, str]":
+    """``(gated_keys, per_series_bands, provisional_floors, source)``
+    — this checkout's published floor tables define WHICH series are
+    gated; the TARGET repo's committed variance artifact defines how
+    wide their bands are (and names itself as ``source`` when it
+    qualifies: non-tiny AND on-chip, the derive_floor_bands bar)."""
+    import bench
+    import kernel_bench
+
+    variance = bench.load_variance(repo)
+    usable = isinstance(variance, dict) and not variance.get("tiny") \
+        and variance.get("platform") == "tpu"
+    entries = (variance or {}).get("entries") or {}
+
+    def band_for(kind, name, stat=None):
+        if not usable:
+            return None
+        e = entries.get(f"{kind}:{name}")
+        if not isinstance(e, dict):
+            return None
+        if stat and isinstance(e.get(stat), dict):
+            e = e[stat]
+        spread = e.get("rel_spread")
+        return float(spread) if isinstance(spread, (int, float)) \
+            and spread > 0 else None
+
+    gated, bands = [], {}
+    provisional = sorted(getattr(bench, "PROVISIONAL_FLOORS", ()))
+    for cfg in sorted({**bench.MFU_FLOORS, **bench.DECODE_FLOORS}):
+        for metric in timeline.RATE_METRICS:
+            key = timeline.series_key("BENCH", cfg, metric)
+            if key in series:
+                gated.append(key)
+                b = band_for("config", cfg)
+                if b is not None:
+                    bands[key] = b
+    for cfg in sorted(bench.DECODE_FLOORS):
+        key = timeline.series_key("BENCH", cfg, "hbm_frac")
+        if key in series:
+            gated.append(key)
+            b = band_for("config", cfg, stat="hbm_frac")
+            if b is not None:
+                bands[key] = b
+    for kern in sorted(kernel_bench.KERNEL_FLOORS):
+        key = timeline.series_key("KERNELBENCH", kern, "roofline_frac")
+        if key in series:
+            gated.append(key)
+            b = band_for("kernel", kern, stat="roofline_frac")
+            if b is not None:
+                bands[key] = b
+    src = None
+    if usable:
+        src = os.path.basename(
+            bench.find_variance_artifact(repo) or "")
+    return gated, bands, provisional, src
+
+
+def build_timeline(repo: str, default_band: float = timeline.DEFAULT_BAND,
+                   round_no: int = 0, max_suspects: int = 30,
+                   gated: "list | None" = None,
+                   bands: "dict | None" = None) -> dict:
+    """The whole pipeline: ingest every family, correlate commits,
+    detect band crossings, attribute suspects.  Raises ``ValueError``
+    on an unknown committed family (the staleness lint).  ``gated`` /
+    ``bands`` override the floor-table-derived sets (tests plant
+    their own)."""
+    ingested = timeline.ingest_repo(repo)
+    if ingested["unknown"]:
+        raise ValueError(
+            f"unknown committed artifact famil(ies) — register a "
+            f"timeline adapter for: {ingested['unknown']}")
+    if ingested["unreadable"]:
+        raise ValueError(
+            f"unreadable/adapter-failed committed artifact(s) — a "
+            f"corrupt gate artifact must be fixed, not skipped: "
+            f"{ingested['unreadable']}")
+    commits = resolve_commits(repo, ingested["coverage"])
+    series = timeline.build_series(ingested["rows"], commits=commits)
+
+    provisional, source = [], None
+    if gated is None:
+        gated, derived_bands, provisional, source = \
+            gated_series_keys(series, repo)
+        if bands is None:
+            bands = derived_bands
+    bands = bands or {}
+    for key in gated:
+        if key in series:
+            series[key]["gated"] = True
+
+    regressions = timeline.detect_regressions(
+        series, gated, bands=bands, default_band=default_band)
+    for row in regressions:
+        family = row["series"].split("|", 1)[0]
+        frm = commits.get((family, row["from_round"]))
+        to = commits.get((family, row["drop_round"]))
+        row["from_commit"] = frm
+        row["drop_commit"] = to
+        row["suspects"] = commits_between(repo, frm, to,
+                                          limit=max_suspects) \
+            if frm and to else []
+
+    head = (_git(repo, "rev-parse", "--short", "HEAD") or "").strip() \
+        or None
+    doc = {
+        "round": round_no,
+        "head": head,
+        "bands": {"default": default_band,
+                  "source": source,
+                  "per_series": {k: round(v, 4)
+                                 for k, v in sorted(bands.items())}},
+        "series": {k: series[k] for k in sorted(series)},
+        "regressions": regressions,
+        "coverage": ingested["coverage"],
+        "unreadable": ingested["unreadable"],
+        "provisional_floors": provisional,
+        "gate": {"regressions": len(regressions),
+                 "ok": not regressions},
+        "note": (
+            "Gated series = configs/kernels carrying published floors "
+            "(bench.MFU_FLOORS / bench.DECODE_FLOORS rate+hbm_frac, "
+            "kernel_bench.KERNEL_FLOORS roofline_frac).  Band = "
+            "recorded rel_spread from the newest non-tiny "
+            "BENCH_VARIANCE_r*.json entry when present, else the "
+            "default (the lower edge of the documented ±2–4% chip-day "
+            "variance).  provisional_floors are CPU-smoke-seeded gate "
+            "entries with no on-chip measurement behind them — "
+            "reported as unmeasured, not as floors."),
+    }
+    return doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--repo", default=str(REPO))
+    ap.add_argument("--emit-json", default=None,
+                    metavar="TIMELINE_rN.json",
+                    help="write the committed timeline artifact "
+                         "(schema-validated before writing)")
+    ap.add_argument("--band", type=float, default=timeline.DEFAULT_BAND,
+                    help="default band width for gated series without "
+                         "a variance entry")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 2 when the regression table is "
+                         "non-empty (driver-round blocking mode)")
+    ap.add_argument("--max-suspects", type=int, default=30)
+    args = ap.parse_args(argv)
+
+    round_no = 0
+    if args.emit_json:
+        m = re.search(r"_r(\d+)\.json$",
+                      os.path.basename(args.emit_json))
+        round_no = int(m.group(1)) if m else 0
+    try:
+        doc = build_timeline(args.repo, default_band=args.band,
+                             round_no=round_no,
+                             max_suspects=args.max_suspects)
+    except ValueError as e:
+        print(f"perf_timeline: LINT ERROR: {e}", file=sys.stderr)
+        return 1
+
+    for row in doc["regressions"]:
+        suspects = ", ".join(s["commit"] for s in row["suspects"])
+        print(f"REGRESSION {row['series']}: "
+              f"{row['best_value']} (r{row['best_round']:02d}) -> "
+              f"{row['newest_value']} (r{row['newest_round']:02d}), "
+              f"-{row['drop_frac'] * 100:.2f}% > band "
+              f"{row['band'] * 100:.1f}%; first dropped "
+              f"r{row['drop_round']:02d}; suspects: {suspects}",
+              file=sys.stderr)
+
+    if args.emit_json:
+        problems = timeline.validate_timeline(doc, repo_dir=args.repo)
+        if problems:
+            print(f"perf_timeline: REFUSING schema-invalid artifact: "
+                  f"{problems}", file=sys.stderr)
+            return 1
+        with open(args.emit_json, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"timeline artifact written: {args.emit_json} "
+              f"({len(doc['series'])} series, "
+              f"{len(doc['regressions'])} regression(s), "
+              f"{len(doc['coverage'])} families)", file=sys.stderr)
+    summary = {"series": len(doc["series"]),
+               "families": sorted(doc["coverage"]),
+               "regressions": doc["regressions"],
+               "gate": doc["gate"]}
+    print(json.dumps(summary))
+    if args.gate and doc["regressions"]:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

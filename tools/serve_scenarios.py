@@ -73,6 +73,19 @@ jax.config.update("jax_threefry_partitionable", True)
 #: gates (a mid-serve retrace or host sync shows up as 100-1000x)
 GATE_K = 20.0
 
+#: absolute decode-step p99 SLO budget (seconds) recorded per cell via
+#: apex_tpu.obs.slo: the tail gate above is the RELATIVE witness
+#: (p99 vs p50); this is the absolute one — a retrace/host-sync
+#: blowout (100-1000x a normal step) violates it on any host, normal
+#: CPU-smoke noise does not.  A chip round tightens it to serving
+#: budgets.
+SLO_DECODE_P99_S = 0.25
+
+#: spec cells additionally carry an acceptance-rate floor objective
+#: (accepted/proposed over the cell window; the measured briefly-
+#: trained rates run 0.8-1.0 — 0.2 is the drafts-are-working bar)
+SLO_MIN_ACCEPTANCE = 0.2
+
 
 def trained_model(tiny: bool):
     """``(cfg, params, ids)`` — briefly trained on a periodic stream
@@ -156,12 +169,30 @@ def run_cell(cfg, params, draft, reqs, *, context, new_tokens,
     eng.step()                       # admission + compile + 1st step
     mark = hist.state()
     tok0 = toks.value
+    # SLO verdicts ride the cell (apex_tpu.obs.slo): evaluated at the
+    # same boundaries the registry already ticks, over resolved host
+    # state only — the first evaluate() below just seeds the window
+    # base at the post-compile mark
+    from apex_tpu.obs.slo import SLObjective, SLOEvaluator
+    objectives = [SLObjective(
+        name="decode_p99", kind="quantile",
+        metric="serve_decode_step_seconds", q=0.99,
+        threshold=SLO_DECODE_P99_S, window=0, min_count=4)]
+    if spec:
+        objectives.append(SLObjective(
+            name="spec_acceptance", kind="ratio",
+            ratio_num="serve_spec_accepted_total",
+            ratio_den="serve_spec_proposed_total", op="ge",
+            threshold=SLO_MIN_ACCEPTANCE, window=0, min_count=4))
+    slo_ev = SLOEvaluator(reg, objectives)
+    slo_ev.evaluate()
     t0 = time.perf_counter()
     guard = 0
     while pending or not eng.sched.idle():
         if pending:
             eng.submit(pending.pop(0))
         eng.step()
+        slo_ev.evaluate()
         guard += 1
         if guard > 100_000:
             raise RuntimeError("scenario cell stalled")
@@ -205,6 +236,10 @@ def run_cell(cfg, params, draft, reqs, *, context, new_tokens,
         "gate": {"tail_ok": bool(tail_ok),
                  "retrace_ok": bool(retrace_ok),
                  "ok": bool(tail_ok and retrace_ok)},
+        # the SLO verdict block (schema-validated when present): the
+        # absolute latency budget + (spec) acceptance floor, judged by
+        # apex_tpu.obs.slo over the cell's own window
+        "slo": slo_ev.summary(),
     }
     if spec:
         rec["acceptance_rate"] = round(
@@ -317,7 +352,13 @@ def main(argv=None) -> int:
     cells_ok = all(c["gate"]["ok"] for c in cells.values())
     gated = [r["spec_wins"] for r in ab if r["gated"]]
     ab_ok = bool(gated) and all(gated)
+    # fleet-level SLO verdict: every cell's objective block clean
+    slo_ok = all(c.get("slo", {}).get("ok", True)
+                 for c in cells.values())
     doc = {
+        "slo": {"decode_p99_budget_s": SLO_DECODE_P99_S,
+                "min_acceptance": SLO_MIN_ACCEPTANCE,
+                "ok": bool(slo_ok)},
         "round": 0,
         "platform": jax.devices()[0].platform,
         "model": "gpt_tiny" if opts.cpu_smoke else "gpt_small_tpu",
