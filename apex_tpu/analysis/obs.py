@@ -67,6 +67,12 @@ OVERHEAD_BUDGET_PCT = 1.0
 #: of the bench-smoke decode step (the ISSUE-13 tracing lane)
 TRACING_BUDGET_PCT = 1.0
 
+#: acceptance bar: the continuous profiler's amortized cost — one
+#: capture window (capture + parse + sentinel) as a percentage of the
+#: step wall over the whole inter-capture interval
+#: (``capture_every × step_wall``); the r03+ ``contprof`` lane
+CONTPROF_BUDGET_PCT = 1.0
+
 #: instrument kinds the export may carry
 METRIC_TYPES = ("counter", "gauge", "histogram")
 
@@ -149,6 +155,61 @@ def validate_obs(doc) -> List[str]:
                     f"tracing overhead_pct {pct} over the "
                     f"{TRACING_BUDGET_PCT}% budget — request tracing "
                     f"must stay off the decode step path")
+
+    cp = doc.get("contprof")
+    if cp is not None:              # optional: r01/r02 predate contprof
+        if not isinstance(cp, dict):
+            problems.append("'contprof' present but not an object")
+        else:
+            complete = True
+            for key in ("capture_s", "parse_s", "sentinel_s",
+                        "window_cost_s", "step_wall_ms",
+                        "overhead_pct"):
+                if not isinstance(cp.get(key), (int, float)) \
+                        or isinstance(cp.get(key), bool):
+                    problems.append(f"contprof missing numeric {key!r}")
+                    complete = False
+            ce = cp.get("capture_every")
+            if not (isinstance(ce, int) and not isinstance(ce, bool)
+                    and ce > 0):
+                problems.append(
+                    "contprof missing positive int 'capture_every'")
+                complete = False
+            if complete:
+                cost = cp["capture_s"] + cp["parse_s"] + \
+                    cp["sentinel_s"]
+                if abs(cp["window_cost_s"] - cost) > \
+                        max(0.01, 0.05 * cost):
+                    problems.append(
+                        f"contprof window_cost_s "
+                        f"{cp['window_cost_s']} does not re-derive "
+                        f"from capture+parse+sentinel = {cost:.4f}")
+                if cp["step_wall_ms"] <= 0:
+                    # an inf 'derived' would make the re-derive
+                    # comparison below vacuous (inf > inf is False) —
+                    # a zero wall is itself a fabrication signal
+                    problems.append(
+                        "contprof step_wall_ms must be > 0 — the "
+                        "overhead re-derivation is meaningless over a "
+                        "zero step wall")
+                else:
+                    interval_s = ce * cp["step_wall_ms"] / 1e3
+                    derived = 100.0 * cp["window_cost_s"] / interval_s
+                    if abs(cp["overhead_pct"] - derived) > \
+                            max(0.02, 0.05 * derived):
+                        problems.append(
+                            f"contprof overhead_pct "
+                            f"{cp['overhead_pct']} does not re-derive "
+                            f"from window_cost / (capture_every x "
+                            f"step_wall) = {derived:.3f}")
+                pct = cp.get("overhead_pct")
+                if isinstance(pct, (int, float)) and \
+                        pct > CONTPROF_BUDGET_PCT:
+                    problems.append(
+                        f"contprof overhead_pct {pct} over the "
+                        f"{CONTPROF_BUDGET_PCT}% budget — the "
+                        f"continuous profiler must stay off the step "
+                        f"path at its recorded cadence")
 
     ex = doc.get("export")
     rows = ex.get("metrics") if isinstance(ex, dict) else None

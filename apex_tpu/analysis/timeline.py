@@ -351,6 +351,36 @@ def _ingest_decode_decompose(doc, prev) -> List[Row]:
     return rows
 
 
+@adapter("PROFILE_DRIFT")
+def _ingest_profile_drift(doc, prev) -> List[Row]:
+    """Continuous-profiler drift rounds: per-session window/drift
+    counts and the last window's bucket fractions + step wall — the
+    longitudinal record of what the live sentinel saw each round."""
+    rows: List[Row] = []
+    band = doc.get("band")
+    if isinstance(band, dict) and _num(band.get("value")):
+        rows.append(("summary", "band", float(band["value"])))
+    if _num(doc.get("k")):
+        rows.append(("summary", "k", float(doc["k"])))
+    for name, sess in sorted((doc.get("sessions") or {}).items()):
+        if not isinstance(sess, dict):
+            continue
+        wins = [w for w in (sess.get("windows") or [])
+                if isinstance(w, dict)]
+        rows.append((name, "windows", float(len(wins))))
+        rows.append((name, "drifts",
+                     float(len(sess.get("drifts") or []))))
+        if wins:
+            last = wins[-1]
+            rows.extend((f"{name}:last_window", k, v)
+                        for k, v in _numeric_items(
+                            last.get("fractions")))
+            if _num(last.get("step_wall_s")):
+                rows.append((f"{name}:last_window", "step_wall_s",
+                             float(last["step_wall_s"])))
+    return rows
+
+
 @adapter("CONVERGENCE")
 def _ingest_convergence(doc, prev) -> List[Row]:
     # shapes vary by round (legacy r02 single record through the r06

@@ -28,6 +28,21 @@ instead of re-implemented inside each:
 - :mod:`apex_tpu.obs.fleet` — fleet-level registry merging (counter
   sums, bucket-union histogram quantiles, per-replica gauge tables) —
   the ONE implementation ``bench.py`` and the serving tools share;
+- :mod:`apex_tpu.obs.stepclass` — the shared compiled-HLO op
+  classifiers (decode / serve-decode seven-bucket vocabulary, the
+  pinned fwd/bwd/optimizer/collectives/host_gap train vocabulary) the
+  offline profile tools AND the continuous profiler bucket through —
+  one copy, so online and offline attribution can never disagree;
+- :mod:`apex_tpu.obs.contprof` — the always-on continuous profiler
+  (bounded sampled capture windows inside the serve/training loops,
+  profiled steps excluded from the gated latency histograms) and the
+  online :class:`~apex_tpu.obs.contprof.DriftSentinel` (K-consecutive
+  out-of-band confirmation against a baseline under the PR-13 band
+  rule; incident + flight note + ``serve_profile_drift`` gauge on
+  confirmation) — the committed ``PROFILE_DRIFT_r*.json`` artifact
+  behind ``apex_tpu/analysis/profile_drift.py``;
+- :mod:`apex_tpu.obs.exposition` — the stdlib HTTP scrape target
+  (``/metrics`` Prometheus text, ``/fleet`` merged view);
 - :mod:`apex_tpu.obs.slo` — declarative SLO objectives over the live
   registry (decode p99, spec acceptance, block utilization) with
   windowed burn-rate evaluation riding the lag-resolved boundary —
@@ -40,7 +55,15 @@ See ``docs/source/observability.rst`` for the metric catalog, the
 lag-resolution contract, and the span naming convention.
 """
 
-from apex_tpu.obs import fleet, slo, xplane
+from apex_tpu.obs import contprof, exposition, fleet, slo, stepclass, xplane
+from apex_tpu.obs.contprof import (
+    ContinuousProfiler,
+    ContProfConfig,
+    DriftSentinel,
+    serve_profiler,
+    train_profiler,
+)
+from apex_tpu.obs.exposition import MetricsServer
 from apex_tpu.obs.flight import FlightRecorder
 from apex_tpu.obs.metrics import (
     Counter,

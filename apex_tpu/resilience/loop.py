@@ -119,6 +119,7 @@ def run_resilient(
     injector: Optional[FaultInjector] = None,
     registry: Optional[obs_metrics.Registry] = None,
     flight: Optional[FlightRecorder] = None,
+    profiler: Optional[Any] = None,
 ) -> RunResult:
     """Drive ``step_fn(state, *batch) -> (state, metrics)`` for
     ``num_steps`` with the protections in the module docstring.
@@ -148,6 +149,17 @@ def run_resilient(
     incident, returned on :attr:`RunResult.flight` either way.
     Steps you hand here should NOT also be wrapped with
     :func:`apex_tpu.obs.metrics.instrument_step` (double counting).
+
+    ``profiler`` (an :class:`apex_tpu.obs.contprof.ContinuousProfiler`,
+    usually from :func:`apex_tpu.obs.contprof.train_profiler`) turns
+    on continuous profiling: every ``capture_every`` dispatches a
+    short window is captured around the step boundary and bucketed
+    into the pinned train vocabulary (fwd/bwd/optimizer/collectives/
+    host_gap) — the classifier is built lazily from THIS loop's
+    jitted step.  Capture is SUPPRESSED across a rewind (an open
+    window is aborted and the cadence restarts — the sentinel must
+    never judge a half-rewound capture), and any window still open
+    when the loop exits is aborted.
 
     On a :class:`~apex_tpu.resilience.faults.SimulatedPreemption` (or a
     real ``KeyboardInterrupt`` that is not the watchdog), in-flight saves
@@ -438,7 +450,27 @@ def run_resilient(
                         injector.on_step_start(i)
                         batch = injector.poison_batch(i, batch)
                         _note_new_faults()
+                    if profiler is not None:
+                        if not profiler.has_classifier_builder:
+                            # the classifier comes from THIS loop's
+                            # own jitted step (lowered lazily at the
+                            # first window close, never executed)
+                            from apex_tpu.obs.contprof import (
+                                train_classifier_builder)
+                            profiler.set_classifier_builder(
+                                train_classifier_builder(
+                                    step_fn, state, batch))
+                        profiler.step_begin()
+                        t_disp = time.perf_counter()
                     state, metrics = step_fn(state, *batch)
+                    if profiler is not None:
+                        # window close blocks on the step's loss (the
+                        # capture must hold the device work it wraps);
+                        # non-window steps record wall only
+                        profiler.step_end(
+                            time.perf_counter() - t_disp,
+                            block_on=metrics.get("loss")
+                            if isinstance(metrics, dict) else None)
                     pending.append((i, metrics))
                 # resolve lagged metrics (all of them once dispatch is done)
                 lag = cfg.sentinel_lag if i < num_steps else 0
@@ -449,6 +481,12 @@ def run_resilient(
                     pending.clear()
                     with lock:
                         inflight.clear()
+                    if profiler is not None:
+                        # capture suppressed while rewinding: abort
+                        # any open window and restart the cadence —
+                        # the re-dispatched timeline must not feed
+                        # the sentinel a half-rewound capture
+                        profiler.suppress()
                     i = jump
                     continue
                 if i < num_steps and cfg.checkpoint_every \
@@ -483,6 +521,11 @@ def run_resilient(
     finally:
         stop.set()
         monitor.join(timeout=1.0)
+        if profiler is not None:
+            # a window still open on any exit path (preemption,
+            # watchdog, normal drain mid-window) must not leak the
+            # process-global tracer
+            profiler.abort_window()
         if manager is not None:
             try:
                 manager.wait()

@@ -252,9 +252,29 @@ class ServeEngine:
                  registry: Optional[obs_metrics.Registry] = None,
                  placement: Optional[Any] = None,
                  tracer: Optional[Any] = None,
-                 trace_name: str = "engine"):
+                 trace_name: str = "engine",
+                 profiler: Optional[Any] = None):
         self.cfg = cfg
         self.scfg = serve_cfg
+        #: continuous profiler (:mod:`apex_tpu.obs.contprof`) — when
+        #: set (usually via :func:`apex_tpu.obs.contprof.
+        #: serve_profiler`), ``step()`` drives its
+        #: ``step_begin``/``step_end`` hooks and steps inside a
+        #: capture window record their latency into
+        #: ``serve_profiled_step_seconds`` INSTEAD of
+        #: ``serve_decode_step_seconds`` — SLO burn rates and the
+        #: bench latency gates never judge a profiled step.  The
+        #: compiled program is untouched either way (the
+        #: contprof-instrumented serve lane stays syncs-clean,
+        #: OBS_r03's evidence).
+        self.profiler = profiler
+        #: admission-dispatch cursor the profiler uses to discard
+        #: contaminated capture windows — counts EVERY non-decode-step
+        #: executable dispatched into this engine's stream: prefill
+        #: chunks here, and the fleet's KV-install scatters
+        #: (``DecodeReplica.admit_shipment`` bumps it), whose
+        #: instruction names collide with the decode program's
+        self._admission_dispatches = 0
         #: per-request lifecycle tracer (apex_tpu.obs.reqtrace) + this
         #: engine's component label in the fleet ("prefill",
         #: "replica0", ...).  None = tracing off: every hook below is
@@ -286,6 +306,9 @@ class ServeEngine:
         self._m_prefill = self.metrics.counter(
             "serve_prefill_chunks_total",
             "fixed-size prefill chunks dispatched")
+        #: created lazily on the first profiled step so an
+        #: unprofiled engine's metric catalog is unchanged
+        self._m_profiled_s = None
         self.sched = SlotScheduler(
             num_slots=serve_cfg.num_slots,
             num_blocks=serve_cfg.num_blocks,
@@ -510,6 +533,7 @@ class ServeEngine:
                 jnp.asarray(padded[None, j:j + c]),
                 jnp.int32(j), jnp.int32(n_valid))
             self._m_prefill.inc()
+            self._admission_dispatches += 1
             if self.tracer is not None:
                 self.tracer.record("prefill_chunk", req.uid,
                                    self.trace_name, start=j,
@@ -568,6 +592,55 @@ class ServeEngine:
                 _, slot, req = plan
                 self._run_prefill(slot, req)
 
+    def decode_step_args(self) -> tuple:
+        """The exact argument tuple ``step()`` dispatches the compiled
+        decode step with — the ONE place the (params, carry,
+        scheduler-state) calling convention lives.  graph_lint's serve
+        lane, the contprof classifier builder, and the obs_report lint
+        lanes all lower with it, so a carry/scheduler field change can
+        never silently diverge them from the dispatched program."""
+        sched = self.sched
+        return (self.top, self.stacked, self.carry,
+                jnp.asarray(sched.last_tok), jnp.asarray(sched.lengths),
+                jnp.asarray(sched.active),
+                jnp.asarray(sched.page_table),
+                jnp.asarray(sched.temperature),
+                jnp.asarray(sched.top_k), jnp.asarray(sched.top_p))
+
+    def _profiler_begin(self) -> bool:
+        """Continuous-profiler window hook before a step dispatch;
+        True = this step is being captured (its latency must go to
+        the profiled histogram so the SLO/latency gates never judge a
+        profiled step).  The calling step's OWN admissions ran before
+        this (before start_trace); the marker catches later steps'
+        admissions landing inside the window."""
+        if self.profiler is None:
+            return False
+        return self.profiler.step_begin(
+            marker=self._admission_dispatches)
+
+    def _observe_step_wall(self, dt: float, in_window: bool) -> None:
+        """Record one step's wall seconds into exactly one of the two
+        partitions, then close the profiler hook — shared by the base
+        and speculative step loops so the exclusion contract holds on
+        both."""
+        if in_window:
+            if self._m_profiled_s is None:
+                self._m_profiled_s = self.metrics.histogram(
+                    "serve_profiled_step_seconds",
+                    "wall seconds of decode steps inside a "
+                    "continuous-profiler capture window — EXCLUDED "
+                    "from serve_decode_step_seconds so latency gates "
+                    "and SLO burn rates never judge a profiled step")
+            self._m_profiled_s.observe(dt)
+        else:
+            # dispatch + the token fetch the host needs anyway — the
+            # decode-step latency the serve bench gates p50/p99 on
+            self._m_step_s.observe(dt)
+        if self.profiler is not None:
+            self.profiler.step_end(
+                dt, marker=self._admission_dispatches)
+
     def step(self) -> Dict[str, np.ndarray]:
         """One step boundary: admit/evict, then one compiled decode
         step over every slot; returns the requests that FINISHED this
@@ -577,17 +650,12 @@ class ServeEngine:
         if not sched.active.any():
             return {}
         n_act = int(sched.active.sum())
+        in_window = self._profiler_begin()
         t0 = time.perf_counter()
-        self.carry, toks = self._decode_exec(
-            self.top, self.stacked, self.carry,
-            jnp.asarray(sched.last_tok), jnp.asarray(sched.lengths),
-            jnp.asarray(sched.active), jnp.asarray(sched.page_table),
-            jnp.asarray(sched.temperature), jnp.asarray(sched.top_k),
-            jnp.asarray(sched.top_p))
+        self.carry, toks = self._decode_exec(*self.decode_step_args())
         toks = np.asarray(toks)
-        # dispatch + the (S,) token fetch the host needs anyway — the
-        # decode-step latency the serve bench gates p50/p99 on
-        self._m_step_s.observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self._observe_step_wall(dt, in_window)
         self._m_tokens.inc(n_act)
         self._steps_dispatched += 1
         finished: Dict[str, np.ndarray] = {}
@@ -620,12 +688,18 @@ class ServeEngine:
         ``{uid: generated token ids}`` for every request ever
         submitted (the prompt is not repeated in the output)."""
         steps = 0
-        while not self.sched.idle():
-            before = self.sched.n_active() + len(self.sched.queue)
-            self.step()
-            steps += 1
-            if steps > max_steps:
-                raise RuntimeError(
-                    f"serve loop exceeded {max_steps} steps with "
-                    f"{before} request(s) outstanding")
+        try:
+            while not self.sched.idle():
+                before = self.sched.n_active() + len(self.sched.queue)
+                self.step()
+                steps += 1
+                if steps > max_steps:
+                    raise RuntimeError(
+                        f"serve loop exceeded {max_steps} steps with "
+                        f"{before} request(s) outstanding")
+        finally:
+            if self.profiler is not None:
+                # a window still open at drain would leak the
+                # process-global tracer into the next loop
+                self.profiler.abort_window()
         return dict(self._outputs)

@@ -106,6 +106,24 @@ class RouterConfig:
     #: rate recovers; insufficient windows never de-rank a fresh
     #: replica.  ``None`` = ranking only, no objectives.
     slo: Optional[tuple] = None
+    #: continuous profiling (an :class:`apex_tpu.obs.contprof.
+    #: ContProfConfig`): every decode replica gets its own profiler —
+    #: capture phases STAGGERED across replicas (the tracer is
+    #: process-global; a colliding window is skipped, not queued) —
+    #: and its own :class:`~apex_tpu.obs.contprof.DriftSentinel`
+    #: over the replica's registry.  A confirmed drift flips the
+    #: replica's ``serve_profile_drift`` gauge (SLO-consumable via
+    #: :func:`apex_tpu.obs.contprof.drift_objective`), notes the
+    #: router's flight recorder, writes a ``profile-drift`` incident
+    #: to ``incident_path``, and DE-RANKS the replica in admission
+    #: (preferred last, never hard-blocked: a fleet whose every
+    #: replica drifted must still serve).  ``None`` = no profiling.
+    contprof: Optional[Any] = None
+    #: sentinel band width / confirmation count for ``contprof``
+    #: (the PR-13 band rule's fallback default; a caller with a
+    #: recorded variance-derived width passes it here)
+    contprof_band: float = 0.03
+    contprof_k: int = 2
 
     def __post_init__(self):
         if self.transfer not in ("ship", "recompute"):
@@ -253,6 +271,12 @@ class DecodeReplica:
         eng.carry = self._install(
             eng.carry, jnp.asarray(sched.page_table[slot]), shp.kv,
             jnp.int32(slot), shp.key)
+        # the install scatter is an admission dispatch like a prefill
+        # chunk: bump the engine's contamination marker so a shipment
+        # landing inside a replica's capture window discards that
+        # window (its scatter ops would misattribute into the decode
+        # buckets)
+        eng._admission_dispatches += 1
         sched.arm(slot, shp.first_token, shp.prompt_len)
         return slot
 
@@ -411,6 +435,42 @@ class DisaggRouter:
                     f"violated in its window; 0 = de-ranked from "
                     f"admission)")
                 for i in range(len(self.replicas))]
+        # -- continuous profiling (apex_tpu.obs.contprof): one
+        # profiler + drift sentinel per replica, phases staggered so
+        # fleet windows never collide on the process-global tracer
+        self.profilers = None
+        self.sentinels = None
+        self._m_rep_drift = []
+        if self.rcfg.contprof is not None:
+            import dataclasses as _dc
+
+            from apex_tpu.obs import contprof as contprof_lib
+            n = len(self.replicas)
+            stride = max(self.rcfg.contprof.capture_steps + 1,
+                         self.rcfg.contprof.capture_every // max(n, 1))
+            self.profilers, self.sentinels = [], []
+            for i, rep in enumerate(self.replicas):
+                sent = contprof_lib.DriftSentinel(
+                    band=self.rcfg.contprof_band,
+                    k=self.rcfg.contprof_k,
+                    registry=rep.eng.metrics,
+                    flight=self.flight,
+                    incident_path=self.rcfg.incident_path,
+                    name="serve")
+                cfg_i = _dc.replace(
+                    self.rcfg.contprof,
+                    phase=self.rcfg.contprof.phase + i * stride)
+                self.sentinels.append(sent)
+                self.profilers.append(contprof_lib.serve_profiler(
+                    rep.eng, config=cfg_i, sentinel=sent))
+            self._m_rep_drift = [
+                self.metrics.gauge(
+                    f"serve_replica{i}_profile_drift",
+                    f"replica {i} confirmed-unrecovered op-level "
+                    f"drift (mirror of its serve_profile_drift "
+                    f"gauge; drifting replicas rank last in "
+                    f"admission)")
+                for i in range(len(self.replicas))]
 
     # -- submission ----------------------------------------------------
 
@@ -440,13 +500,23 @@ class DisaggRouter:
         a free slot + footprint coverage, block utilization under the
         admission bar; ranked by (outstanding work, utilization,
         decode p99)."""
-        scored = [(r.load(), r) for r in self.replicas
+        scored = [((self._drifting(r),) + r.load(), r)
+                  for r in self.replicas
                   if r.can_admit(req) and not self._slo_violating(r)]
         eligible = [(load, r) for load, r in scored
-                    if load[1] < self.rcfg.admit_block_util]
+                    if load[2] < self.rcfg.admit_block_util]
         if not eligible:
             return None
         return min(eligible, key=lambda lr: lr[0])[1]
+
+    def _drifting(self, rep: DecodeReplica) -> bool:
+        """True when the replica's drift sentinel holds a confirmed,
+        unrecovered op-level drift — it ranks LAST in admission (a
+        soft de-rank, not a block: a fleet whose every replica
+        drifted must still serve)."""
+        if self.sentinels is None:
+            return False
+        return self.sentinels[rep.index].drifting
 
     def _slo_violating(self, rep: DecodeReplica) -> bool:
         """True when the replica's LAST boundary evaluation has a
@@ -518,6 +588,9 @@ class DisaggRouter:
                 self.slo_evals[i].evaluate()
                 self._m_rep_slo[i].set(
                     0.0 if self.slo_evals[i].violated() else 1.0)
+            if self.sentinels is not None:
+                self._m_rep_drift[i].set(
+                    1.0 if self.sentinels[i].drifting else 0.0)
         self.metrics.tick()
 
     def slo_summary(self) -> "Optional[dict]":
@@ -536,16 +609,21 @@ class DisaggRouter:
         """Drain the fleet; ``{uid: generated token ids}`` for every
         request ever submitted (prompt not repeated)."""
         steps = 0
-        while not self.idle():
-            outstanding = len(self.queue) + sum(
-                r.eng.sched.n_active() + len(r.eng.sched.queue)
-                for r in self.replicas if r.alive)
-            self.step()
-            steps += 1
-            if steps > max_steps:
-                raise RuntimeError(
-                    f"router loop exceeded {max_steps} steps with "
-                    f"{outstanding} request(s) outstanding")
+        try:
+            while not self.idle():
+                outstanding = len(self.queue) + sum(
+                    r.eng.sched.n_active() + len(r.eng.sched.queue)
+                    for r in self.replicas if r.alive)
+                self.step()
+                steps += 1
+                if steps > max_steps:
+                    raise RuntimeError(
+                        f"router loop exceeded {max_steps} steps with "
+                        f"{outstanding} request(s) outstanding")
+        finally:
+            if self.profilers is not None:
+                for prof in self.profilers:
+                    prof.abort_window()
         return dict(self._outputs)
 
     # -- failure semantics --------------------------------------------
@@ -565,6 +643,12 @@ class DisaggRouter:
         if not rep.alive:
             return []
         rep.alive = False
+        if self.profilers is not None:
+            # a dead replica steps no more, so its open capture window
+            # would hold the process-global capture lock forever and
+            # silently stop fleet-wide profiling during exactly the
+            # incident the sentinel exists for
+            self.profilers[index].abort_window()
         if self.flight is not None:
             self.flight.note("replica_kill", replica=index,
                              active=rep.eng.sched.n_active(),

@@ -430,6 +430,10 @@ class SpecEngine(ServeEngine):
         sched = self.sched
         if not sched.active.any():
             return {}
+        # same continuous-profiler contract as the base step(): a
+        # captured round (draft + verify dispatches) records into
+        # serve_profiled_step_seconds, never the gated histogram
+        in_window = self._profiler_begin()
         t0 = time.perf_counter()
         args = (jnp.asarray(sched.last_tok), jnp.asarray(sched.lengths),
                 jnp.asarray(sched.active), jnp.asarray(sched.page_table),
@@ -442,7 +446,7 @@ class SpecEngine(ServeEngine):
             self.top, self.stacked, self.carry, proposals, *args)
         cand = np.asarray(cand)
         n_emit = np.asarray(n_emit)
-        self._m_step_s.observe(time.perf_counter() - t0)
+        self._observe_step_wall(time.perf_counter() - t0, in_window)
         n_act = int(sched.active.sum())
         k = self.spec.k
         self._m_rounds.inc()

@@ -1027,3 +1027,97 @@ def test_repo_variance_and_timeline_validate():
         "BENCH|bert_large_tpu_heads_lamb_o2|seq_s"}
     assert sorted(REPO.glob("BENCH_VARIANCE_r*.json")), \
         "the variance gate artifact must be committed"
+
+
+# ---------------------------------------------------------------------------
+# PROFILE_DRIFT_r*.json — the continuous-profile drift artifacts
+# ---------------------------------------------------------------------------
+
+def _valid_profile_drift():
+    base = {"source": "first-window", "step_wall_s": 0.003,
+            "fractions": {"param_read": 0.1, "kv_read": 0.6,
+                          "kv_write": 0.05, "attention": 0.02,
+                          "sampling": 0.15, "host_sync": 0.0,
+                          "other": 0.08}}
+    drifted = dict(base["fractions"], kv_read=0.8, sampling=0.0)
+    clean_w = [{"index": 0, "fractions": dict(base["fractions"]),
+                "step_wall_s": 0.003, "out_of_band": []},
+               {"index": 1, "fractions": dict(base["fractions"]),
+                "step_wall_s": 0.0031, "out_of_band": []}]
+    exc = [{"metric": "kv_read", "value": 0.8, "baseline": 0.6,
+            "delta": 0.2},
+           {"metric": "sampling", "value": 0.0, "baseline": 0.15,
+            "delta": -0.15}]
+    seeded_w = [{"index": 0, "fractions": dict(base["fractions"]),
+                 "step_wall_s": 0.003, "out_of_band": []},
+                {"index": 1, "fractions": drifted,
+                 "step_wall_s": 0.003, "out_of_band": exc},
+                {"index": 2, "fractions": drifted,
+                 "step_wall_s": 0.003, "out_of_band": exc}]
+    return {"round": 1, "platform": "cpu", "kind": "serve-decode",
+            "config": {}, "band": {"value": 0.05, "source": "test"},
+            "k": 2,
+            "sessions": {
+                "clean": {"baseline": base, "windows": clean_w,
+                          "drifts": [], "quiet": True},
+                "seeded": {"baseline": base, "windows": seeded_w,
+                           "seed": {"bucket": "kv_read",
+                                    "factor": 2.0, "from_window": 1},
+                           "drifts": [{"window": 2,
+                                       "bucket": "kv_read",
+                                       "windows_out": 2}],
+                           "quiet": False}},
+            "gate": {"clean_quiet": True, "seeded_caught": True,
+                     "ok": True},
+            "note": "test"}
+
+
+def test_committed_profile_drift_validated_against_schema(tmp_repo):
+    _analysis_module(tmp_repo, "profile_drift")
+    (tmp_repo / "PROFILE_DRIFT_r07.json").write_text('{"round": 7}')
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "bad drift record")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("PROFILE_DRIFT_r07.json" in p
+               for p in verdict["invalid_profile_drifts"])
+    assert gate_hygiene.main(["--repo", str(tmp_repo)]) == 1
+
+
+def test_profile_drift_quiet_contradiction_fails_hygiene(tmp_repo):
+    """A quiet verdict over recorded out-of-band windows that replay
+    to a confirmed drift is the lie the schema exists to reject."""
+    _analysis_module(tmp_repo, "profile_drift")
+    doc = _valid_profile_drift()
+    doc["sessions"]["seeded"]["drifts"] = []
+    doc["sessions"]["seeded"]["quiet"] = True
+    doc["gate"]["seeded_caught"] = False
+    doc["gate"]["ok"] = False
+    (tmp_repo / "PROFILE_DRIFT_r08.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "suppressed drift")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("CONTRADICTORY" in p
+               for p in verdict["invalid_profile_drifts"])
+
+
+def test_valid_profile_drift_passes_and_untracked_fails(tmp_repo):
+    _analysis_module(tmp_repo, "profile_drift")
+    (tmp_repo / "PROFILE_DRIFT_r09.json").write_text(
+        json.dumps(_valid_profile_drift()))
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]            # parked-but-untracked
+    assert verdict["untracked"] == ["PROFILE_DRIFT_r09.json"]
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "drift round")
+    assert gate_hygiene.check(str(tmp_repo))["ok"]
+
+
+def test_repo_profile_drift_validates():
+    """The committed PROFILE_DRIFT_r01 is the schema's reference
+    instance, and the committed OBS round carries the contprof lane
+    (both ride the repo-level hygiene check in tier-1)."""
+    assert gate_hygiene._validate_profile_drifts(str(REPO)) == []
+    assert sorted(REPO.glob("PROFILE_DRIFT_r*.json")), \
+        "the profile-drift gate artifact must be committed"

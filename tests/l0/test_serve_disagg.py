@@ -137,6 +137,11 @@ def test_mixed_stream_ship_matches_solo_bitwise(setup, fleet):
     assert m.counter("serve_kv_shipments_total").value == 5
     assert m.counter("serve_kv_transfer_bytes").value > 0
     assert m.counter("serve_reroute_total").value == 0
+    # every installed shipment moved its replica's admission-dispatch
+    # marker: a KV-install scatter landing inside a contprof capture
+    # window must discard that window exactly like a prefill would
+    assert sum(r.eng._admission_dispatches
+               for r in fleet.replicas) == 5
     # drained: router + per-replica gauges back to idle
     assert m.gauge("serve_router_queue_depth").value == 0
     for i in range(2):

@@ -285,6 +285,45 @@ def test_spec_config_and_draft_validation(setup):
                    registry=Registry())
 
 
+def test_spec_profiler_partitions_latency_histograms(setup, engine):
+    """The continuous-profiler contract holds on the SPECULATIVE
+    engine too: attaching a profiler drives real capture windows, a
+    captured round's latency lands in serve_profiled_step_seconds
+    (never the gated histogram the SLO/latency gates judge), and the
+    two partitions cover every dispatched round exactly.  The
+    classifier builds from the VERIFY program — the target's
+    per-round work."""
+    from apex_tpu.obs import contprof
+
+    cfg, params, prompts = setup
+    eng = engine
+    reg = eng.metrics
+    gated_before = reg.histogram("serve_decode_step_seconds").count
+    prof = contprof.serve_profiler(
+        eng, config=contprof.ContProfConfig(
+            capture_every=3, capture_steps=2, warmup_steps=1,
+            max_windows=1, max_overhead_pct=None))
+    try:
+        rounds_before = eng._steps_dispatched
+        for i, p in enumerate(prompts[:2]):
+            eng.submit(Request(uid=f"prof{i}", prompt=p,
+                               max_new_tokens=16))
+        eng.run()
+        rounds = eng._steps_dispatched - rounds_before
+        gated = reg.histogram("serve_decode_step_seconds").count \
+            - gated_before
+        profiled = reg.histogram("serve_profiled_step_seconds").count
+        captured = sum(w["steps"] for w in prof.windows) \
+            + sum(w["steps"] for w in prof.discarded)
+        assert len(prof.windows) + len(prof.discarded) == 1
+        assert profiled == captured == 2
+        assert gated + profiled == rounds
+        for w in prof.windows:
+            assert w["total_ps"] > 0
+    finally:
+        eng.profiler = None
+
+
 def test_verify_step_has_no_host_sync_or_retrace_hazard(setup, engine):
     """The syncs pass over the ACTUAL lowered b×(k+1) verify step: no
     host callback, no statically-bound numeric scalar (the runtime

@@ -87,6 +87,15 @@ This check fails (exit 1) when
   samples — a spread wide enough to excuse a floor drop cannot be
   typed in) — the statistics every derived floor and band width ride
   are gate memory like the floors themselves, or
+- a committed ``PROFILE_DRIFT_r*.json`` does not validate against
+  the continuous-profile drift schema
+  (``apex_tpu/analysis/profile_drift.py``: band + k, a clean session
+  and a seeded-regression session whose recorded windows REPLAY to
+  the stated verdicts under the one sentinel rule — a quiet verdict
+  over a recorded out-of-band window run, an invented drift, or a
+  first drift not naming the seeded bucket is CONTRADICTORY and
+  schema-invalid) — the live drift tripwire's evidence is gate
+  memory like the offline profiles, or
 - a committed ``TIMELINE_r*.json`` does not validate against the
   timeline schema (``apex_tpu/analysis/timeline.py``: every
   regression row must cite a series whose recorded points actually
@@ -131,7 +140,8 @@ PATTERNS = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json",
             "OBS_r*.json", "DECODE_PROFILE_r*.json",
             "CONVERGENCE_r*.json", "EXPORT_r*.json",
             "SERVE_DISAGG_r*.json", "SCENARIO_r*.json",
-            "TRACE_r*.json", "TIMELINE_r*.json")
+            "TRACE_r*.json", "TIMELINE_r*.json",
+            "PROFILE_DRIFT_r*.json")
 
 #: Round-numbered incident artifacts additionally get schema-validated.
 INCIDENT_PATTERN = "INCIDENT_r*.json"
@@ -170,8 +180,11 @@ TRACE_PATTERN = "TRACE_r*.json"
 #: derived floors) ...
 VARIANCE_PATTERN = "BENCH_VARIANCE_r*.json"
 
-#: ... and the longitudinal perf-timeline artifacts.
+#: ... and the longitudinal perf-timeline artifacts ...
 TIMELINE_PATTERN = "TIMELINE_r*.json"
+
+#: ... and the continuous-profile drift artifacts.
+PROFILE_DRIFT_PATTERN = "PROFILE_DRIFT_r*.json"
 
 
 def _load_by_path(repo: str, *rel: str):
@@ -381,6 +394,22 @@ def _validate_timelines(repo: str) -> "list[str]":
     return problems
 
 
+def _validate_profile_drifts(repo: str) -> "list[str]":
+    """Schema problems over every present PROFILE_DRIFT_r*.json, as
+    ``path: problem`` strings
+    (``apex_tpu/analysis/profile_drift.py`` — which also replays the
+    sentinel rule over the recorded windows)."""
+    schema = _load_by_path(repo, "apex_tpu", "analysis",
+                           "profile_drift.py")
+    if schema is None:
+        return []
+    problems = []
+    for p in sorted(Path(repo).glob(PROFILE_DRIFT_PATTERN)):
+        for msg in schema.validate_profile_drift_file(str(p)):
+            problems.append(f"{p.name}: {msg}")
+    return problems
+
+
 def _git(repo: str, *args: str) -> "str | None":
     """stdout of a git command, or None when git/The repo is unavailable
     (the best-effort contract)."""
@@ -410,7 +439,8 @@ def check(repo: str = str(REPO)) -> dict:
                 "invalid_profiles": [], "invalid_convergences": [],
                 "invalid_exports": [], "invalid_serve_disaggs": [],
                 "invalid_scenarios": [], "invalid_traces": [],
-                "invalid_variances": [], "invalid_timelines": []}
+                "invalid_variances": [], "invalid_timelines": [],
+                "invalid_profile_drifts": []}
     tracked = set(tracked_raw.split())
     missing = [f for f in REQUIRED
                if not (Path(repo) / f).exists() or f not in tracked]
@@ -443,12 +473,14 @@ def check(repo: str = str(REPO)) -> dict:
     invalid_trace = _validate_traces(repo)
     invalid_var = _validate_variances(repo)
     invalid_tl = _validate_timelines(repo)
+    invalid_pd = _validate_profile_drifts(repo)
     return {"ok": not (missing or untracked or dirty or invalid
                        or invalid_mem or invalid_prec or invalid_dec
                        or invalid_obs or invalid_prof or invalid_conv
                        or invalid_exp or invalid_disagg
                        or invalid_scen or invalid_trace
-                       or invalid_var or invalid_tl),
+                       or invalid_var or invalid_tl
+                       or invalid_pd),
             "missing": missing, "untracked": untracked, "dirty": dirty,
             "invalid_incidents": invalid,
             "invalid_memlints": invalid_mem,
@@ -462,7 +494,8 @@ def check(repo: str = str(REPO)) -> dict:
             "invalid_scenarios": invalid_scen,
             "invalid_traces": invalid_trace,
             "invalid_variances": invalid_var,
-            "invalid_timelines": invalid_tl}
+            "invalid_timelines": invalid_tl,
+            "invalid_profile_drifts": invalid_pd}
 
 
 def main(argv=None) -> int:
@@ -493,7 +526,9 @@ def main(argv=None) -> int:
               f"{verdict.get('invalid_traces', [])}; invalid variance "
               f"records {verdict.get('invalid_variances', [])}; "
               f"invalid/stale timeline records "
-              f"{verdict.get('invalid_timelines', [])}",
+              f"{verdict.get('invalid_timelines', [])}; invalid "
+              f"profile-drift records "
+              f"{verdict.get('invalid_profile_drifts', [])}",
               file=sys.stderr)
         return 1
     return 0

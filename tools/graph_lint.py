@@ -218,12 +218,16 @@ def build_decode_step(batch: int = 1, prefill: int = 8,
     return gen._generate_impl, args, kwargs, a.properties
 
 
-def build_serve_step(num_slots: int = 2, block_size: int = 4,
-                     num_blocks: int = 9, max_blocks_per_slot: int = 4):
-    """(jitted_step, args, properties): the serve engine's compiled
-    continuous-batching decode step at a tiny config — paged KV pools
-    + per-slot page tables + fused sampling epilogue, carries donated —
-    plus the O2 serving policy the params were cast under."""
+def build_serve_engine(num_slots: int = 2, block_size: int = 4,
+                       num_blocks: int = 9,
+                       max_blocks_per_slot: int = 4,
+                       prefill_chunk: int = None, registry=None):
+    """(engine, properties): the ONE construction of the tiny-gpt
+    serve engine every serve lane shares — gpt_tiny init, the O2
+    serving cast, ``ServeConfig`` — used by the lint lanes here, the
+    obs_report overhead/lint lanes, and ``tools/continuous_profile``,
+    so a carry or scheduler change can never leave an overhead lane
+    measuring a different engine than the one the serve gate lints."""
     from apex_tpu.models.gpt import GPTModel, gpt_tiny
     from apex_tpu.serve import ServeConfig, ServeEngine
 
@@ -236,15 +240,20 @@ def build_serve_step(num_slots: int = 2, block_size: int = 4,
     scfg = ServeConfig(num_slots=num_slots, block_size=block_size,
                        num_blocks=num_blocks,
                        max_blocks_per_slot=max_blocks_per_slot,
-                       prefill_chunk=block_size)
-    eng = ServeEngine(params, cfg, scfg)
-    s = eng.sched
-    args = (eng.top, eng.stacked, eng.carry,
-            jnp.asarray(s.last_tok), jnp.asarray(s.lengths),
-            jnp.asarray(s.active), jnp.asarray(s.page_table),
-            jnp.asarray(s.temperature), jnp.asarray(s.top_k),
-            jnp.asarray(s.top_p))
-    return eng._decode_step, args, a.properties
+                       prefill_chunk=prefill_chunk or block_size)
+    eng = ServeEngine(params, cfg, scfg, registry=registry)
+    return eng, a.properties
+
+
+def build_serve_step(num_slots: int = 2, block_size: int = 4,
+                     num_blocks: int = 9, max_blocks_per_slot: int = 4):
+    """(jitted_step, args, properties): the serve engine's compiled
+    continuous-batching decode step at a tiny config — paged KV pools
+    + per-slot page tables + fused sampling epilogue, carries donated —
+    plus the O2 serving policy the params were cast under."""
+    eng, props = build_serve_engine(num_slots, block_size, num_blocks,
+                                    max_blocks_per_slot)
+    return eng._decode_step, eng.decode_step_args(), props
 
 
 def build_serve_prefill(num_slots: int = 2, block_size: int = 4,
@@ -256,27 +265,16 @@ def build_serve_prefill(num_slots: int = 2, block_size: int = 4,
     program the disaggregated fleet's prefill worker dispatches on its
     own mesh slice.  ``start``/``n_valid`` are DYNAMIC int32 args
     (one executable per chunk shape, never per position)."""
-    from apex_tpu.models.gpt import GPTModel, gpt_tiny
-    from apex_tpu.serve import ServeConfig, ServeEngine
-
-    cfg = gpt_tiny()
-    model = GPTModel(cfg)
-    params = model.init(jax.random.PRNGKey(0),
-                        jnp.zeros((1, 4), jnp.int32))["params"]
-    a = amp.initialize(opt_level="O2", verbosity=0)
-    params = a.model_params_from(params)
-    scfg = ServeConfig(num_slots=num_slots, block_size=block_size,
-                       num_blocks=num_blocks,
-                       max_blocks_per_slot=max_blocks_per_slot,
-                       prefill_chunk=block_size)
-    eng = ServeEngine(params, cfg, scfg)
+    eng, a_props = build_serve_engine(num_slots, block_size,
+                                      num_blocks, max_blocks_per_slot)
+    scfg = eng.scfg
     s = eng.sched
     args = (eng.top, eng.stacked, eng.carry["kc"], eng.carry["vc"],
             eng.carry.get("ks"), eng.carry.get("vs"),
             jnp.asarray(s.page_table[0]),
             jnp.zeros((1, scfg.prefill_chunk), jnp.int32),
             jnp.int32(0), jnp.int32(scfg.prefill_chunk))
-    return eng._prefill_chunk, args, a.properties
+    return eng._prefill_chunk, args, a_props
 
 
 def build_serve_verify(num_slots: int = 2, block_size: int = 4,

@@ -1,0 +1,118 @@
+"""Serve the metrics registry over HTTP — a real scrape target.
+
+Stands up :class:`apex_tpu.obs.exposition.MetricsServer` (stdlib
+``http.server``; zero dependencies) in front of a live registry:
+
+- ``/metrics`` — the registry's Prometheus text exposition (the SAME
+  ``Registry.to_prometheus`` export the committed OBS artifacts pin);
+- ``/fleet`` — the :mod:`apex_tpu.obs.fleet` merged view when fleet
+  registries are attached (counters summed, histogram buckets
+  unioned, gauges tabulated per replica as ``# gauge-table`` lines);
+- ``/healthz`` — liveness.
+
+With ``--demo`` the tool first drives a short instrumented train +
+serve sample (the ``tools/obs_report.py`` export workload) so the
+scrape returns a populated catalog instead of an empty registry —
+that is also what the smoke test GETs.  ``--once`` performs one local
+GET of ``/metrics`` and exits (scripted smoke; exit 1 when the scrape
+fails).
+
+Usage:
+    python tools/obs_serve.py [--port 9464] [--host 127.0.0.1]
+        [--demo] [--once] [--duration SECONDS]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+os.environ.setdefault("APEX_TPU_KERNELS", "jnp")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms",
+                  os.environ.get("APEX_TPU_TEST_PLATFORM", "cpu"))
+
+from apex_tpu.obs import metrics as obs_metrics  # noqa: E402
+from apex_tpu.obs.exposition import MetricsServer  # noqa: E402
+
+
+def demo_registry() -> obs_metrics.Registry:
+    """A populated registry: a few instrumented train steps + a short
+    serve stream (the obs_report export-sample workload)."""
+    import obs_report
+    reg = obs_metrics.Registry()
+    snapshot = obs_report.export_sample(quick=True)
+    # export_sample builds its own registry; replay its resolved
+    # state into ours so the scrape carries the full catalog
+    for row in snapshot["metrics"]:
+        if row["type"] == "counter":
+            reg.counter(row["name"], row["help"])._apply_scalar(
+                row["value"])
+        elif row["type"] == "gauge":
+            reg.gauge(row["name"], row["help"])._apply_scalar(
+                row["value"])
+        else:
+            h = reg.histogram(row["name"], row["help"])
+            n = int(row["count"])
+            if n > 0:
+                # replay every observation at the recorded mean so
+                # bucket counts, _sum and _count stay mutually
+                # consistent — a scrape with _count > 0 over all-zero
+                # buckets would feed histogram_quantile() nonsense
+                import bisect
+                mean = row["sum"] / n
+                h.counts[bisect.bisect_left(h.bounds, mean)] += n
+                h.sum, h.count = row["sum"], n
+    return reg
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9464)
+    ap.add_argument("--demo", action="store_true",
+                    help="populate the registry with a short "
+                         "instrumented train+serve sample first")
+    ap.add_argument("--once", action="store_true",
+                    help="serve, GET /metrics once from localhost, "
+                         "print it, exit (smoke mode)")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="serve for N seconds then exit (default: "
+                         "until interrupted)")
+    opts = ap.parse_args(argv)
+
+    registry = demo_registry() if opts.demo else obs_metrics.DEFAULT
+    srv = MetricsServer(registry=registry, host=opts.host,
+                        port=0 if opts.once else opts.port)
+    host, port = srv.start()
+    print(f"serving /metrics /fleet /healthz on http://{host}:{port}",
+          file=sys.stderr)
+    try:
+        if opts.once:
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics", timeout=5) as r:
+                body = r.read().decode()
+            print(body)
+            return 0 if "# TYPE" in body else 1
+        end = None if opts.duration is None \
+            else time.monotonic() + opts.duration
+        while end is None or time.monotonic() < end:
+            time.sleep(0.5)
+        return 0
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -66,139 +66,11 @@ jax.config.update("jax_platforms",
 import decode_decompose  # noqa: E402  (sibling tool: shared lowering)
 from apex_tpu.analysis.decode_profile import BUCKETS  # noqa: E402
 from apex_tpu.obs import xplane  # noqa: E402
-
-_DEF_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+) = (.*)$")
-_CALLS_RE = re.compile(
-    r"(?:calls|body|condition|to_apply|branch_computations)="
-    r"[{(]?%?([\w.\-]+)")
-_CALLBACKS = ("python_cpu_callback", "python_gpu_callback",
-              "python_tpu_callback", "tpu_host_callback", "infeed",
-              "outfeed")
-
-
-def _computations(hlo: str) -> dict:
-    """``{computation name: [body lines]}`` of an HLO text dump."""
-    comps: dict = {}
-    cur = None
-    for raw in hlo.splitlines():
-        s = raw.strip()
-        if s.endswith("{") and " = " not in s and "(" in s:
-            cur = s.split()[0].lstrip("%").split("(")[0]
-            comps[cur] = []
-        elif cur is not None:
-            comps[cur].append(raw)
-            if s == "}":
-                cur = None
-    return comps
-
-
-def _closure(comps: dict, roots) -> set:
-    """Computation names reachable from ``roots`` through
-    calls/body/condition/to_apply references."""
-    seen = set()
-    work = list(roots)
-    while work:
-        name = work.pop()
-        if name in seen or name not in comps:
-            continue
-        seen.add(name)
-        for raw in comps[name]:
-            for m in _CALLS_RE.finditer(raw):
-                work.append(m.group(1))
-    return seen
-
-
-class StepClassifier:
-    """instruction name -> bucket, for the decode while-body's
-    instructions, built from the compiled HLO text.
-
-    Shape markers (HLO type strings like ``bf16[12,8,2304,4,64]``):
-    the full cache pool ``(L,B,M,H,D)``, a cache-slice
-    materialization ``(B,M,H,D)`` (the DECODE_DECOMPOSE residual
-    candidate — tracked separately as ``slice_copy`` evidence), the
-    vocab dimension, and the context length M.  Classification mirrors
-    the static walk's conventions: ops reading the cache feed
-    ``kv_read``; cache writes ``kv_write``; weight-operand dots and
-    the embedding gather ``param_read``; vocab-shaped non-dot ops
-    ``sampling``; M-length score-chain tensors ``attention``."""
-
-    def __init__(self, hlo: str, cfg, batch: int, m_ctx: int):
-        L, H = cfg.num_layers, cfg.num_heads
-        D = cfg.hidden_size // cfg.num_heads
-        V = cfg.vocab_size
-        self.cache_full = f"[{L},{batch},{m_ctx},{H},{D}]"
-        self.cache_slices = (f"[{batch},{m_ctx},{H},{D}]",
-                             f"[1,{batch},{m_ctx},{H},{D}]")
-        self.vocab_marks = (f",{V}]", f"[{V},")
-        self.m_marks = (f",{m_ctx},", f",{m_ctx}]")
-        comps = _computations(hlo)
-        # the decode loop = while bodies whose closure touches the
-        # cache pool (prefill has no full-pool operand)
-        bodies = []
-        for lines in comps.values():
-            for raw in lines:
-                if " while(" not in raw:
-                    continue
-                bm = re.search(r"body=%?([\w.\-]+)", raw)
-                if bm:
-                    bodies.append(bm.group(1))
-        step_comps = set()
-        for body in bodies:
-            cl = _closure(comps, [body])
-            if any(self.cache_full in raw
-                   for c in cl for raw in comps.get(c, [])):
-                step_comps |= cl
-        if not step_comps:
-            raise RuntimeError(
-                "no while body touching the KV cache pool "
-                f"{self.cache_full} found — the compiled layout "
-                "changed; update StepClassifier")
-        self.buckets: dict = {}
-        self.slice_copy_ops: set = set()
-        for cname in step_comps:
-            for raw in comps[cname]:
-                m = _DEF_RE.match(raw)
-                if not m:
-                    continue
-                name, rest = m.groups()
-                text = rest
-                cm = re.search(r"calls=%?([\w.\-]+)", rest)
-                if cm and cm.group(1) in comps:
-                    text = rest + "\n" + "\n".join(comps[cm.group(1)])
-                self.buckets[name] = self._bucket(name, rest, text)
-
-    def _bucket(self, name: str, defline: str, text: str):
-        if any(cb in text for cb in _CALLBACKS):
-            return "host_sync"
-        if "dynamic-update-slice" in text and self.cache_full in text:
-            return "kv_write"
-        cacheish = self.cache_full in text or \
-            any(cs in text for cs in self.cache_slices)
-        dot = re.search(r"\bdot\(", text) is not None
-        if cacheish:
-            result_type = defline.split(" ")[0]
-            if not dot and any(cs in result_type
-                               for cs in self.cache_slices):
-                # a materialized cache-slice-shaped RESULT with no
-                # consuming dot in the same fusion: the slice-copy
-                # candidate the walk's residual points at
-                self.slice_copy_ops.add(name)
-            return "kv_read"
-        if dot or "convolution(" in text:
-            return "param_read"
-        if any(vm in text for vm in self.vocab_marks):
-            if "gather(" in text:
-                return "param_read"          # embedding-row gather
-            return "sampling"
-        if any(mm in text for mm in self.m_marks):
-            return "attention"
-        return None                          # -> "other"
-
-    def step_ops(self) -> set:
-        return set(self.buckets)
-
-    def __call__(self, name: str):
-        return self.buckets.get(name)
+# the compiled-HLO shape classifier lives in the obs library now (the
+# continuous profiler runs the same bucketing online; one copy means
+# the offline tool and the live sentinel can never disagree) — this
+# tool only drives the capture and emits the artifact
+from apex_tpu.obs.stepclass import StepClassifier  # noqa: E402
 
 
 def build_and_run(batch: int, prefill: int, new_tokens: int,
