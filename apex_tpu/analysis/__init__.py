@@ -59,8 +59,16 @@ from apex_tpu.analysis import syncs       # noqa: F401  (registers)
 from apex_tpu.analysis import dflow        # noqa: F401  (shared walker)
 from apex_tpu.analysis import precision    # noqa: F401  (registers)
 from apex_tpu.analysis import export       # noqa: F401  (registers)
+from apex_tpu.analysis import spmd         # noqa: F401  (registers)
 
 from apex_tpu.analysis.collectives import collective_audit, collective_table
+from apex_tpu.analysis.spmd import (
+    collective_schedule,
+    compare_lowerings,
+    diff_schedules,
+    reshape_pair_findings,
+    schedule_fingerprint,
+)
 
 __all__ = [
     "analyze", "analyze_lowered", "build_context", "lower_quiet",
@@ -68,6 +76,8 @@ __all__ = [
     "ArgInfo", "OutInfo", "PassContext", "Finding", "Report",
     "PASSES", "DEFAULT_PASSES", "SEVERITIES",
     "collective_audit", "collective_table",
+    "collective_schedule", "compare_lowerings", "diff_schedules",
+    "reshape_pair_findings", "schedule_fingerprint",
     "donation", "sharding", "collectives", "constants", "policy",
-    "memory", "cost", "syncs", "dflow", "precision", "export",
+    "memory", "cost", "syncs", "dflow", "precision", "export", "spmd",
 ]

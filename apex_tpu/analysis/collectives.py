@@ -43,6 +43,37 @@ _COLLECTIVE_RE = re.compile(
 _SHAPE_RE = re.compile(
     r"(pred|s8|u8|s16|u16|f16|bf16|s32|u32|f32|s64|u64|f64|c64|c128)"
     r"\[([0-9,]*)\]")
+#: attribute spellings on a compiled-HLO collective line
+_CHANNEL_RE = re.compile(r"channel_id=(\d+)")
+_GROUPS_RE = re.compile(
+    r"replica_groups=(\{(?:[^{}]|\{[^{}]*\})*\}"       # {{0,1},{2,3}} / {}
+    r"|\[[0-9,]*\](?:<=\[[0-9,]*\])?)")                # iota [2,4]<=[8]
+_GLOBAL_IDS_RE = re.compile(r"use_global_device_ids=true")
+
+
+def canon_groups(spelling: str) -> str:
+    """Canonical ``{{0,1},{2,3}}`` form of a replica_groups attribute,
+    accepting the compiled-HLO braces form, the StableHLO
+    ``dense<[[0, 1], [2, 3]]>`` form, and the iota form (kept verbatim,
+    whitespace-stripped)."""
+    s = re.sub(r"\s", "", spelling)
+    if "<=" in s:                    # iota spelling has no literal groups
+        return s
+    inner = re.findall(r"[\[{]([0-9,]*)[\]}]", s)
+    return "{" + ",".join("{" + g.strip(",") + "}" for g in inner) + "}"
+
+
+def collective_attrs(line: str) -> dict:
+    """``{channel_id, replica_groups, use_global_device_ids}`` parsed
+    from one compiled-HLO collective line (``None``/``False`` when the
+    attribute is absent)."""
+    cm = _CHANNEL_RE.search(line)
+    gm = _GROUPS_RE.search(line)
+    return {
+        "channel_id": int(cm.group(1)) if cm else None,
+        "replica_groups": canon_groups(gm.group(1)) if gm else None,
+        "use_global_device_ids": bool(_GLOBAL_IDS_RE.search(line)),
+    }
 
 
 def shape_bytes(dtype: str, dims: str) -> int:
@@ -64,7 +95,13 @@ def collective_table(hlo_text: str) -> Dict[str, dict]:
     whose result is the *smallest* element — so the same logical
     collective audits identical bytes whether XLA emits the sync or
     async spelling (the spelling itself is recorded in ``sync``/
-    ``async``)."""
+    ``async``).
+
+    Channel wiring is recorded too: ``channels`` (sorted distinct
+    ``channel_id`` values), ``replica_groups`` (distinct canonical
+    spellings, first-seen order) and ``global_ids`` (ops carrying
+    ``use_global_device_ids=true``) — the attributes the SPMD
+    consistency pass diffs across ranks."""
     table: Dict[str, dict] = {}
     for m in _COLLECTIVE_RE.finditer(hlo_text):
         if m.group("variant") == "-done":
@@ -77,11 +114,24 @@ def collective_table(hlo_text: str) -> Dict[str, dict]:
             nbytes = pick(elems, default=0)
         else:
             nbytes = sum(elems)   # sync tuple results are all real buffers
+        start = hlo_text.rfind("\n", 0, m.start()) + 1
+        end = hlo_text.find("\n", m.end())
+        attrs = collective_attrs(
+            hlo_text[start:end if end != -1 else len(hlo_text)])
         slot = table.setdefault(kind, {"count": 0, "bytes": 0,
-                                       "sync": 0, "async": 0})
+                                       "sync": 0, "async": 0,
+                                       "channels": [], "replica_groups": [],
+                                       "global_ids": 0})
         slot["count"] += 1
         slot["bytes"] += nbytes
         slot["async" if m.group("variant") == "-start" else "sync"] += 1
+        if attrs["channel_id"] is not None \
+                and attrs["channel_id"] not in slot["channels"]:
+            slot["channels"] = sorted(slot["channels"] + [attrs["channel_id"]])
+        if attrs["replica_groups"] is not None \
+                and attrs["replica_groups"] not in slot["replica_groups"]:
+            slot["replica_groups"].append(attrs["replica_groups"])
+        slot["global_ids"] += int(attrs["use_global_device_ids"])
     return table
 
 

@@ -88,6 +88,9 @@ class Op:
     #: ``stablehlo.return`` operand lists of regions this op owns
     region_returns: List[Tuple[str, ...]] = dataclasses.field(
         default_factory=list)
+    #: enclosing region-owner ops, outermost first (``while``/``case``/
+    #: ``if``/``reduce``/... bodies this op's line sits inside)
+    owners: Tuple["Op", ...] = ()
 
     @property
     def result_type(self) -> Optional[str]:
@@ -204,7 +207,8 @@ def parse_module(text: str) -> Dict[str, FuncDef]:
                 operands = tuple(_VALUE.findall(tail.split(" : ")[0]))
             op = Op(lineno=lineno, line=line, name=name, result=result,
                     n_results=n_results, operands=operands,
-                    types=tuple(_TENSOR.findall(line)), depth=depth - 1)
+                    types=tuple(_TENSOR.findall(line)), depth=depth - 1,
+                    owners=tuple(o for o, _d in region_stack))
             if name == "return":
                 if depth == 1 and "stablehlo" not in om.group(3):
                     cur.returns.append(op)

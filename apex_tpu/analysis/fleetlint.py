@@ -1,0 +1,180 @@
+"""FLEETLINT_r*.json — schema for the committed cross-rank SPMD lint.
+
+``tools/graph_lint.py --lanes fleet --emit-json FLEETLINT_rN.json``
+writes one of these per round: the DDP O1/O2 train steps lowered once
+per rank on the virtual mesh, plus the 8→4 shrink / 4→8 regrow reshape
+pair, each lane's per-rank collective-schedule fingerprints and a
+``consistent`` verdict (:mod:`apex_tpu.analysis.spmd`).  Like MEMLINT
+and PRECLINT, the artifact is gate memory: ``tools/gate_hygiene.py``
+validates every committed ``FLEETLINT_r*.json`` against this schema so
+"the fleet's collective schedules agree" can't rot into prose nobody
+machine-checks.
+
+This module is deliberately **stdlib-only** (no jax import):
+``gate_hygiene`` loads it directly by file path the same way it loads
+``analysis/memlint.py`` and ``analysis/preclint.py``.
+
+Document shape::
+
+    {
+      "round": 1,
+      "platform": "cpu",
+      "n_ranks": 8,                # ranks per-rank lanes were lowered for
+      "lanes": {
+        "<lane>": {                # e.g. "ddp_o1_train", "reshape_8to4"
+          "compare": "schedule",   # full identity | "opcodes" (reshape
+                                   #   pairs: groups/bytes legally change)
+          "consistent": true,      # MUST re-derive from the hashes below
+          "ranks": {
+            "<label>": {           # "0".."7", or "mesh8"/"mesh4"
+              "schedule_hash": "...",   # sha256 of the canonical schedule
+              "opcode_hash": "...",     # sha256 of the (kind,variant) seq
+              "n_collectives": 3
+            }, ...
+          },
+          "findings": {"error": 0, "warning": 0, "info": 1},
+          "mismatches": [          # non-empty IFF not consistent
+            {"ranks": ["0", "7"], "index": 2,
+             "a": "all-reduce(bf16, 32B, ...)",   # first diverging op,
+             "b": "all-reduce(f32, 64B, ...)"}    #   both spellings
+          ]
+        }, ...
+      },
+      "gate": {"ok": true, "inconsistent_lanes": 0}   # re-derived
+    }
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+_COMPARE_KEY = {"schedule": "schedule_hash", "opcodes": "opcode_hash"}
+
+_RANK_REQUIRED = {
+    "schedule_hash": lambda v: isinstance(v, str) and len(v) >= 12,
+    "opcode_hash": lambda v: isinstance(v, str) and len(v) >= 12,
+    "n_collectives": lambda v: isinstance(v, int) and v >= 0,
+}
+
+
+def _validate_lane(name: str, lane: dict, problems: List[str]) -> None:
+    compare = lane.get("compare")
+    if compare not in _COMPARE_KEY:
+        problems.append(f"lane {name!r} has invalid 'compare': "
+                        f"{compare!r} (want 'schedule' or 'opcodes')")
+        return
+    if not isinstance(lane.get("consistent"), bool):
+        problems.append(f"lane {name!r} missing/invalid 'consistent' "
+                        f"(bool)")
+        return
+    ranks = lane.get("ranks")
+    if not isinstance(ranks, dict) or len(ranks) < 2:
+        problems.append(f"lane {name!r} needs a 'ranks' object with >= 2 "
+                        f"entries (a one-sided comparison proves nothing)")
+        return
+    for label, rec in ranks.items():
+        if not isinstance(rec, dict):
+            problems.append(f"lane {name!r} rank {label!r} is not an "
+                            f"object")
+            return
+        for key, check in _RANK_REQUIRED.items():
+            if not check(rec.get(key)):
+                problems.append(f"lane {name!r} rank {label!r} has "
+                                f"missing/invalid {key!r}: "
+                                f"{rec.get(key)!r}")
+                return
+    fnd = lane.get("findings")
+    if fnd is not None and not (isinstance(fnd, dict) and all(
+            isinstance(n, int) and n >= 0 for n in fnd.values())):
+        problems.append(f"lane {name!r} has invalid 'findings': {fnd!r}")
+
+    # the contradiction rule: the verdict must re-derive from the
+    # recorded per-rank hashes under the lane's own comparison mode
+    key = _COMPARE_KEY[compare]
+    derived = len({rec[key] for rec in ranks.values()}) == 1
+    if lane["consistent"] != derived:
+        problems.append(
+            f"lane {name!r}: consistent={lane['consistent']} contradicts "
+            f"the recorded per-rank {key} values (which "
+            f"{'agree' if derived else 'disagree'})")
+
+    mismatches = lane.get("mismatches")
+    if not isinstance(mismatches, list):
+        problems.append(f"lane {name!r} missing 'mismatches' (list)")
+        return
+    if derived and mismatches:
+        problems.append(f"lane {name!r}: mismatch rows recorded on a "
+                        f"hash-consistent lane")
+    if not derived and not mismatches:
+        problems.append(f"lane {name!r}: hashes disagree but no mismatch "
+                        f"row names the first diverging op")
+    for i, row in enumerate(mismatches):
+        if not isinstance(row, dict):
+            problems.append(f"lane {name!r} mismatch[{i}] is not an "
+                            f"object")
+            continue
+        pair = row.get("ranks")
+        if not (isinstance(pair, list) and len(pair) == 2 and all(
+                isinstance(x, str) and x in ranks for x in pair)):
+            problems.append(f"lane {name!r} mismatch[{i}] 'ranks' must "
+                            f"name two recorded rank labels: {pair!r}")
+        if not (isinstance(row.get("index"), int) and row["index"] >= 0):
+            problems.append(f"lane {name!r} mismatch[{i}] missing "
+                            f"'index' (int >= 0)")
+        for side in ("a", "b"):
+            v = row.get(side)
+            if not (isinstance(v, str) and v.strip()):
+                problems.append(f"lane {name!r} mismatch[{i}] must spell "
+                                f"the diverging op on side {side!r}")
+
+
+def validate_fleetlint(doc) -> List[str]:
+    """Problems with one parsed FLEETLINT document (empty = valid)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not a JSON object"]
+    if not isinstance(doc.get("round"), int):
+        problems.append("missing/invalid 'round' (int)")
+    if not isinstance(doc.get("platform"), str):
+        problems.append("missing/invalid 'platform' (str)")
+    if not (isinstance(doc.get("n_ranks"), int) and doc["n_ranks"] >= 2):
+        problems.append("missing/invalid 'n_ranks' (int >= 2)")
+    lanes = doc.get("lanes")
+    if not isinstance(lanes, dict) or not lanes:
+        return problems + ["missing/empty 'lanes' object"]
+    for name, lane in lanes.items():
+        if not isinstance(lane, dict):
+            problems.append(f"lane {name!r} is not an object")
+            continue
+        _validate_lane(name, lane, problems)
+
+    gate = doc.get("gate")
+    if not isinstance(gate, dict):
+        problems.append("missing 'gate' object")
+        return problems
+    bad = sorted(name for name, lane in lanes.items()
+                 if isinstance(lane, dict)
+                 and lane.get("consistent") is False)
+    if not isinstance(gate.get("ok"), bool):
+        problems.append("gate missing/invalid 'ok' (bool)")
+    elif gate["ok"] != (not bad):
+        problems.append(f"gate.ok={gate['ok']} contradicts the lanes "
+                        f"(inconsistent: {bad or 'none'})")
+    if not isinstance(gate.get("inconsistent_lanes"), int):
+        problems.append("gate missing/invalid 'inconsistent_lanes' (int)")
+    elif gate["inconsistent_lanes"] != len(bad):
+        problems.append(
+            f"gate.inconsistent_lanes={gate['inconsistent_lanes']} "
+            f"contradicts the lanes (counted {len(bad)})")
+    return problems
+
+
+def validate_fleetlint_file(path: str) -> List[str]:
+    """Problems with one FLEETLINT_r*.json file (empty = valid)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable fleetlint JSON: {e}"]
+    return validate_fleetlint(doc)

@@ -1,0 +1,452 @@
+"""Cross-rank SPMD consistency lint and collective-deadlock detector.
+
+The whole distributed story — flat-bucket allreduce DDP, SyncBatchNorm's
+cross-replica stats, the elastic fleet that shrinks on preemption and
+regrows on recovery — rests on one unstated invariant: *every rank
+executes the same collective schedule*.  One rank compiling a different
+collective order (the fork's signSGD hack was exactly a one-rank
+payload divergence) hangs the whole fleet with no diagnostic: rank 7
+sits in an all-reduce nobody else entered.  Every previous pass in this
+package audits ONE lowering; this module compares lowerings across
+ranks, meshes and reshape transitions, and turns the hang into a named,
+gateable finding.
+
+Three layers:
+
+- :func:`collective_schedule` — the program-order sequence of
+  collective ops in a lowering (pre-optimization StableHLO or compiled
+  HLO), each entry carrying opcode, channel wiring (``channel_id``,
+  ``replica_groups``, ``use_global_device_ids``), payload dtypes/bytes
+  and the enclosing control-flow region from the :mod:`.dflow` SSA
+  walker.  :func:`schedule_fingerprint` hashes it canonically — the
+  digest the runtime preflight all-gathers
+  (:func:`apex_tpu.parallel.multiproc.spmd_preflight`).
+- :func:`diff_schedules` / :func:`compare_lowerings` — structural diff
+  of N schedules emitting ``spmd-schedule-mismatch`` (different op
+  sequence: the static deadlock), ``spmd-group-mismatch`` (same
+  sequence, different replica_groups / channel wiring / region
+  placement) and ``spmd-bytes-mismatch`` (payload disagreement — the
+  signSGD class: a bucket that travels sign-compressed or at a
+  different width on one rank).  Every mismatch finding names the
+  first diverging op in BOTH spellings.
+- the registered ``spmd-consistency`` pass — on a single lowering it
+  runs the *deadlock-shape* check: a collective under a rank-divergent
+  predicate (inside an ``if``/``case``/``while`` whose condition
+  depends on ``partition_id``/``replica_id``-derived values) is
+  ``spmd-conditional-collective``, the one divergence visible without
+  a peer to diff against.  With ``peers=`` it additionally diffs the
+  context's schedule against each peer lowering.
+
+:func:`reshape_pair_findings` is the elastic-fleet corollary: across a
+mesh reshape (the DurableCheckpointManager 8→4 shrink / 4→8 regrow
+lanes) byte-identical schedules are *impossible* (group sizes change),
+but the opcode sequence must survive — a shrink that adds or reorders
+collectives would deadlock the regrown fleet mid-rewind.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from apex_tpu.analysis.collectives import (_COLLECTIVE_RE, _SHAPE_RE,
+                                           canon_groups, collective_attrs,
+                                           shape_bytes)
+from apex_tpu.analysis.core import PassContext, register_pass
+from apex_tpu.analysis.dflow import (dims_of, element_type, parse_module)
+from apex_tpu.analysis.report import Finding
+
+#: StableHLO collective opcodes (short form) -> HLO dash spelling
+_STABLEHLO_COLLECTIVES = {
+    "all_reduce": "all-reduce",
+    "all_gather": "all-gather",
+    "reduce_scatter": "reduce-scatter",
+    "collective_permute": "collective-permute",
+    "all_to_all": "all-to-all",
+    "collective_broadcast": "collective-broadcast",
+}
+#: ops whose result is rank-identifying — the taint sources for the
+#: conditional-collective (static deadlock) check
+_RANK_SOURCES = ("partition_id", "replica_id")
+#: control-flow owners whose predicate choosing a branch/iteration can
+#: make a nested collective rank-divergent
+_BRANCH_OWNERS = ("if", "case", "while")
+
+_SH_CHANNEL_RE = re.compile(r"channel_handle\s*=.*?handle\s*=\s*(\d+)")
+_SH_GROUPS_RE = re.compile(r"replica_groups\s*=\s*dense<([^>]*)>")
+_SH_ELEM_BYTES = {"i1": 1, "f8E4M3FN": 1, "f8E4M3B11FNUZ": 1, "f8E5M2": 1}
+#: compiled-HLO computation header: ``%region_0.4 (...) -> ... {`` /
+#: ``ENTRY %main.10 (...) -> ... {``
+_HLO_COMP_RE = re.compile(
+    r"^\s*(?P<entry>ENTRY\s+)?%(?P<name>[\w.$-]+)\s*\(.*\)\s*->.*\{")
+
+
+def _sh_elem_bytes(elem: str) -> int:
+    """Byte width of a StableHLO element type (``f32``, ``bf16``,
+    ``i64``, ``i1``, ...)."""
+    if elem in _SH_ELEM_BYTES:
+        return _SH_ELEM_BYTES[elem]
+    m = re.search(r"(\d+)$", elem)
+    return max(1, int(m.group(1)) // 8) if m else 4
+
+
+def _entry(kind: str, variant: str, attrs: Mapping[str, Any],
+           dtypes: Sequence[str], nbytes: int, lineno: int,
+           region: Optional[str]) -> Dict[str, Any]:
+    return {"kind": kind, "variant": variant,
+            "channel_id": attrs.get("channel_id"),
+            "replica_groups": attrs.get("replica_groups"),
+            "use_global_device_ids":
+                bool(attrs.get("use_global_device_ids")),
+            "dtypes": list(dtypes), "bytes": int(nbytes),
+            "lineno": lineno, "region": region}
+
+
+def _schedule_from_hlo(hlo_text: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    comp: Optional[str] = None
+    for lineno, line in enumerate(hlo_text.splitlines(), 1):
+        cm = _HLO_COMP_RE.match(line)
+        if cm:
+            comp = None if cm.group("entry") else cm.group("name")
+            continue
+        m = _COLLECTIVE_RE.search(line)
+        if not m or m.group("variant") == "-done":
+            continue
+        kind = m.group("kind")
+        shapes = _SHAPE_RE.findall(m.group("shape"))
+        elems = [shape_bytes(dt, dims) for dt, dims in shapes]
+        if m.group("variant") == "-start":
+            pick = min if kind == "reduce-scatter" else max
+            nbytes = pick(elems, default=0)
+            idx = elems.index(nbytes) if elems else 0
+            dtypes = [shapes[idx][0]] if shapes else []
+        else:
+            nbytes = sum(elems)
+            dtypes = [dt for dt, _dims in shapes]
+        out.append(_entry(
+            kind, "async" if m.group("variant") == "-start" else "sync",
+            collective_attrs(line), dtypes, nbytes, lineno, comp))
+    return out
+
+
+def _schedule_from_funcs(funcs) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    for func in funcs.values():
+        for op in func.ops:
+            kind = _STABLEHLO_COLLECTIVES.get(op.name)
+            if kind is None:
+                continue
+            cm = _SH_CHANNEL_RE.search(op.line)
+            gm = _SH_GROUPS_RE.search(op.line)
+            attrs = {
+                "channel_id": int(cm.group(1)) if cm else None,
+                "replica_groups": canon_groups(gm.group(1)) if gm else None,
+                "use_global_device_ids":
+                    "use_global_device_ids" in op.line,
+            }
+            # result-role payloads: with a full (operands) -> (results)
+            # signature the trailing n_results payloads are the results;
+            # otherwise fall back to the last payload
+            types = op.types
+            if len(types) >= 2 * op.n_results:
+                results = types[-op.n_results:]
+            else:
+                results = types[-1:]
+            dtypes = [element_type(t) for t in results]
+            nbytes = sum(
+                int(_sh_elem_bytes(element_type(t))) *
+                max(1, _prod(dims_of(t))) for t in results)
+            region = "/".join(
+                dict.fromkeys(o.name for o in op.owners
+                              if o.name in _BRANCH_OWNERS)) or None
+            out.append(_entry(kind, "sync", attrs, dtypes, nbytes,
+                              op.lineno, region))
+    out.sort(key=lambda e: e["lineno"])
+    return out
+
+
+def _prod(dims: Tuple[int, ...]) -> int:
+    n = 1
+    for d in dims:
+        n *= d
+    return n
+
+
+def _module_text(program: Any) -> str:
+    """Accept a lowering (``.as_text()``), module text, or an already
+    parsed schedule passthrough marker (callers pass lists through
+    :func:`_as_schedule`)."""
+    as_text = getattr(program, "as_text", None)
+    if callable(as_text):
+        return as_text()
+    if isinstance(program, str):
+        return program
+    raise TypeError(
+        f"expected a lowering or module text, got {type(program).__name__}")
+
+
+def _as_schedule(program: Any) -> List[Dict[str, Any]]:
+    if isinstance(program, list):
+        return program
+    return collective_schedule(_module_text(program))
+
+
+def collective_schedule(text: str) -> List[Dict[str, Any]]:
+    """Program-order collective schedule of a lowering.
+
+    Accepts pre-optimization StableHLO (``lowered.as_text()``) or
+    compiled HLO; each entry is ``{kind, variant, channel_id,
+    replica_groups, use_global_device_ids, dtypes, bytes, lineno,
+    region}`` where ``region`` names the enclosing control-flow
+    construct(s) (``"while"``, ``"if"``, a non-entry HLO computation)
+    or is ``None`` at top level.  ``-done`` halves of async HLO pairs
+    are skipped so sync and async spellings of the same logical
+    collective yield one entry each."""
+    if "stablehlo." in text:
+        return _schedule_from_funcs(parse_module(text))
+    return _schedule_from_hlo(text)
+
+
+#: entry keys that define schedule identity across ranks (``lineno`` is
+#: text layout, not semantics)
+_IDENTITY_KEYS = ("kind", "variant", "channel_id", "replica_groups",
+                  "use_global_device_ids", "dtypes", "bytes", "region")
+#: the wiring subset — same op sequence, different plumbing
+_WIRING_KEYS = ("channel_id", "replica_groups", "use_global_device_ids",
+                "variant", "region")
+#: the payload subset — the signSGD class
+_PAYLOAD_KEYS = ("dtypes", "bytes")
+
+
+def serialize_schedule(schedule: Sequence[Mapping[str, Any]]) -> str:
+    """Canonical JSON of a schedule's identity (stable across ranks
+    whose programs are equal; ``lineno`` excluded)."""
+    return json.dumps(
+        [{k: e.get(k) for k in _IDENTITY_KEYS} for e in schedule],
+        sort_keys=True, separators=(",", ":"))
+
+
+def schedule_fingerprint(schedule: Sequence[Mapping[str, Any]],
+                         opcodes_only: bool = False) -> str:
+    """sha256 hex digest of the canonical schedule — the value ranks
+    exchange in the preflight barrier.  ``opcodes_only=True`` hashes
+    just the ``(kind, variant)`` sequence, the invariant that must
+    survive a mesh reshape."""
+    if opcodes_only:
+        payload = json.dumps([[e.get("kind"), e.get("variant")]
+                              for e in schedule],
+                             separators=(",", ":"))
+    else:
+        payload = serialize_schedule(schedule)
+    return hashlib.sha256(payload.encode("utf-8")).hexdigest()
+
+
+def format_entry(entry: Optional[Mapping[str, Any]]) -> str:
+    """One collective entry as a human-readable spelling —
+    ``all-reduce(f32, 32B, groups={{0,...,7}}, channel=1, global-ids)``
+    — or ``<end of schedule>`` for a missing entry (length mismatch)."""
+    if entry is None:
+        return "<end of schedule>"
+    parts = [",".join(entry.get("dtypes") or ["?"]),
+             f"{entry.get('bytes', 0)}B"]
+    if entry.get("replica_groups") is not None:
+        parts.append(f"groups={entry['replica_groups']}")
+    if entry.get("channel_id") is not None:
+        parts.append(f"channel={entry['channel_id']}")
+    if entry.get("use_global_device_ids"):
+        parts.append("global-ids")
+    if entry.get("variant") == "async":
+        parts.append("async")
+    if entry.get("region"):
+        parts.append(f"in {entry['region']}")
+    return f"{entry.get('kind', '?')}({', '.join(parts)})"
+
+
+def first_divergence(a: Sequence[Mapping[str, Any]],
+                     b: Sequence[Mapping[str, Any]],
+                     keys: Sequence[str] = _IDENTITY_KEYS,
+                     ) -> Optional[Tuple[int, str, str]]:
+    """First position where two schedules disagree on ``keys``:
+    ``(index, spelling_a, spelling_b)``, or ``None`` when equal."""
+    for i in range(max(len(a), len(b))):
+        ea = a[i] if i < len(a) else None
+        eb = b[i] if i < len(b) else None
+        if ea is None or eb is None or \
+                any(ea.get(k) != eb.get(k) for k in keys):
+            return i, format_entry(ea), format_entry(eb)
+    return None
+
+
+def diff_schedules(label_a: str, sched_a: Sequence[Mapping[str, Any]],
+                   label_b: str, sched_b: Sequence[Mapping[str, Any]],
+                   ) -> List[Finding]:
+    """Structural diff of two collective schedules.
+
+    Tiered: a different opcode *sequence* is ``spmd-schedule-mismatch``
+    (the static deadlock — one rank enters a collective the other never
+    issues); same sequence but different channel wiring / groups /
+    region placement is ``spmd-group-mismatch`` (ranks rendezvous on
+    mismatched channels); same wiring but different payload dtypes or
+    bytes is ``spmd-bytes-mismatch`` (the signSGD class — the bucket
+    travels at a different width on one rank).  Each finding names the
+    first diverging op in both spellings."""
+    kinds = ("kind",)
+    d = first_divergence(sched_a, sched_b, kinds)
+    if d is not None:
+        i, sa, sb = d
+        return [Finding(
+            "spmd-consistency", "error",
+            f"collective schedules diverge at op #{i}: "
+            f"{label_a} issues {sa} but {label_b} issues {sb} "
+            f"({len(sched_a)} vs {len(sched_b)} collectives) — "
+            f"a fleet mixing these lowerings deadlocks here",
+            op="spmd-schedule-mismatch", count=i,
+            example=f"{label_a}: {sa} | {label_b}: {sb}")]
+    findings: List[Finding] = []
+    d = first_divergence(sched_a, sched_b, _WIRING_KEYS)
+    if d is not None:
+        i, sa, sb = d
+        findings.append(Finding(
+            "spmd-consistency", "error",
+            f"same collective sequence but wiring diverges at op #{i}: "
+            f"{label_a} issues {sa} but {label_b} issues {sb} "
+            f"(replica_groups / channel / region disagree)",
+            op="spmd-group-mismatch", count=i,
+            example=f"{label_a}: {sa} | {label_b}: {sb}"))
+        return findings
+    d = first_divergence(sched_a, sched_b, _PAYLOAD_KEYS)
+    if d is not None:
+        i, sa, sb = d
+        findings.append(Finding(
+            "spmd-consistency", "error",
+            f"same collective sequence but payload diverges at op #{i}: "
+            f"{label_a} sends {sa} but {label_b} sends {sb} "
+            f"(the signSGD class: one rank's bucket travels at a "
+            f"different width)",
+            op="spmd-bytes-mismatch", count=i,
+            example=f"{label_a}: {sa} | {label_b}: {sb}"))
+    return findings
+
+
+def compare_lowerings(programs: Mapping[str, Any]) -> List[Finding]:
+    """Diff N lowerings (one per rank / mesh): ``{label: lowering |
+    module text | schedule list}``.  Every label is compared against
+    the first (reference) label; findings are the union."""
+    items = list(programs.items())
+    if len(items) < 2:
+        return []
+    ref_label, ref_prog = items[0]
+    ref_sched = _as_schedule(ref_prog)
+    findings: List[Finding] = []
+    for label, prog in items[1:]:
+        findings.extend(diff_schedules(
+            ref_label, ref_sched, label, _as_schedule(prog)))
+    return findings
+
+
+def reshape_pair_findings(label_a: str, prog_a: Any,
+                          label_b: str, prog_b: Any) -> List[Finding]:
+    """Reshape-compatibility check for an elastic shrink/regrow pair
+    (e.g. the 8-device and 4-device train-step lowerings around a
+    DurableCheckpointManager mesh change).  Across a reshape the group
+    sizes and bytes legitimately differ; what must survive is the
+    *opcode sequence* — emitted as ``spmd-schedule-mismatch`` when it
+    doesn't, an ``info`` confirmation when it does."""
+    sa, sb = _as_schedule(prog_a), _as_schedule(prog_b)
+    d = first_divergence(sa, sb, ("kind", "variant"))
+    if d is not None:
+        i, spell_a, spell_b = d
+        return [Finding(
+            "spmd-consistency", "error",
+            f"reshape pair {label_a}->{label_b} changes the collective "
+            f"sequence at op #{i}: {spell_a} vs {spell_b} — a fleet "
+            f"rewound across this reshape deadlocks on its first step",
+            op="spmd-schedule-mismatch", count=i,
+            example=f"{label_a}: {spell_a} | {label_b}: {spell_b}")]
+    return [Finding(
+        "spmd-consistency", "info",
+        f"reshape pair {label_a}->{label_b} opcode-consistent "
+        f"({len(sa)} collectives, opcode fingerprint "
+        f"{schedule_fingerprint(sa, opcodes_only=True)[:12]})",
+        op="reshape-pair", count=len(sa))]
+
+
+def conditional_collective_findings(stablehlo_text: str) -> List[Finding]:
+    """The static deadlock shape: a collective nested in a control-flow
+    region whose predicate depends on rank identity.
+
+    Forward taint from ``partition_id`` / ``replica_id`` results over
+    the SSA graph (single pass, while-header aliases resolved — the
+    same conservative stance as the precision walker); a collective
+    whose enclosing ``if``/``case`` predicate operand — or ANY carried
+    operand of an enclosing ``while`` (its condition region reads the
+    carried values, so this is conservative) — resolves into the taint
+    set diverges per rank: some ranks enter the collective, others
+    never do, and the fleet hangs."""
+    return _conditional_findings(parse_module(stablehlo_text))
+
+
+def _conditional_findings(funcs) -> List[Finding]:
+    findings: List[Finding] = []
+    for func in funcs.values():
+        tainted: set = set()
+        for op in func.ops:
+            hit = op.name in _RANK_SOURCES or any(
+                func.resolve(t) in tainted for t in op.operands)
+            if hit and op.result is not None:
+                tainted.add(op.result)
+        if not tainted:
+            continue
+        for op in func.ops:
+            kind = _STABLEHLO_COLLECTIVES.get(op.name)
+            if kind is None or not op.owners:
+                continue
+            for owner in op.owners:
+                if owner.name not in _BRANCH_OWNERS:
+                    continue
+                preds = owner.operands if owner.name == "while" \
+                    else owner.operands[:1]
+                if any(func.resolve(t) in tainted for t in preds):
+                    findings.append(Finding(
+                        "spmd-consistency", "error",
+                        f"{kind} at line {op.lineno} executes under a "
+                        f"rank-divergent predicate: the enclosing "
+                        f"{owner.name} (line {owner.lineno}) is "
+                        f"conditioned on partition/replica identity — "
+                        f"ranks taking different branches deadlock "
+                        f"the collective",
+                        op="spmd-conditional-collective",
+                        lineno=op.lineno, example=op.line.strip()[:160]))
+                    break
+    return findings
+
+
+def spmd_pass(ctx: PassContext,
+              peers: Optional[Mapping[str, Any]] = None) -> List[Finding]:
+    """The registered ``spmd-consistency`` pass.
+
+    On a single lowering: the conditional-collective (static deadlock)
+    check plus an ``info`` schedule summary carrying the fingerprint
+    the preflight would exchange.  With ``peers`` (``{label: lowering |
+    text | schedule}``) the context's schedule is additionally diffed
+    against each peer."""
+    funcs = ctx.memo("dflow",                 # shared with the precision
+                     lambda: parse_module(ctx.stablehlo_text))  # pass
+    findings = _conditional_findings(funcs)
+    sched = ctx.memo("spmd_schedule",
+                     lambda: _schedule_from_funcs(funcs))
+    findings.append(Finding(
+        "spmd-consistency", "info",
+        f"collective schedule: {len(sched)} op(s), fingerprint "
+        f"{schedule_fingerprint(sched)[:12]}",
+        op="schedule", count=len(sched)))
+    for label, prog in (peers or {}).items():
+        findings.extend(diff_schedules(
+            "this", sched, label, _as_schedule(prog)))
+    return findings
+
+
+register_pass("spmd-consistency", spmd_pass)

@@ -283,6 +283,29 @@ def _ingest_preclint(doc, prev) -> List[Row]:
     return rows
 
 
+@adapter("FLEETLINT")
+def _ingest_fleetlint(doc, prev) -> List[Row]:
+    """Cross-rank SPMD lint rounds: per-lane consistency verdict (1.0 =
+    every rank compiled the same collective schedule) and the lane's
+    collective count, plus the gate's inconsistent-lane total."""
+    rows: List[Row] = []
+    for lane, rec in sorted((doc.get("lanes") or {}).items()):
+        if not isinstance(rec, dict):
+            continue
+        if isinstance(rec.get("consistent"), bool):
+            rows.append((lane, "consistent", float(rec["consistent"])))
+        counts = [r["n_collectives"]
+                  for r in (rec.get("ranks") or {}).values()
+                  if isinstance(r, dict) and _num(r.get("n_collectives"))]
+        if counts:
+            rows.append((lane, "n_collectives", float(max(counts))))
+    gate = doc.get("gate")
+    if isinstance(gate, dict) and _num(gate.get("inconsistent_lanes")):
+        rows.append(("gate", "inconsistent_lanes",
+                     float(gate["inconsistent_lanes"])))
+    return rows
+
+
 @adapter("SCENARIO")
 def _ingest_scenario(doc, prev) -> List[Row]:
     rows: List[Row] = []

@@ -20,12 +20,15 @@ to spawn per chip on a Cloud TPU VM.  This module provides:
 
 from __future__ import annotations
 
+import hashlib
 import inspect
+import itertools
+import json
 import os
 import subprocess
 import sys
 import time
-from typing import List, Optional, Sequence
+from typing import Any, List, Optional, Sequence
 
 import jax
 
@@ -34,6 +37,167 @@ class ClusterInitError(RuntimeError):
     """Cluster formation failed within the configured timeout/retry
     budget — with the expected world shape and candidate missing ranks
     in the message, instead of an indefinite hang."""
+
+
+class SpmdPreflightError(ClusterInitError):
+    """The SPMD preflight barrier found a rank whose lowered program
+    diverges from its peers — the message names the first differing
+    collective in both spellings.  Raised on EVERY rank (all ranks see
+    the same all-gathered digests), so the whole fleet aborts with a
+    diagnosis instead of wedging in the first mismatched collective."""
+
+
+#: per-process preflight round counter (namespaces the KV-store keys so
+#: a re-run barrier never reads a previous round's digests)
+_PREFLIGHT_SEQ = itertools.count()
+
+
+def _kv_client():
+    """The cluster coordination-service KV client, or ``None`` when the
+    process is not distributed-initialized (or the internal API moved —
+    the caller then falls back to an all-gather exchange)."""
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client
+    except Exception:  # noqa: BLE001 - internal API; degrade, don't crash
+        return None
+
+
+def spmd_preflight(program: Any, label: str = "train_step") -> dict:
+    """Cross-rank SPMD consistency barrier: hash this rank's lowered
+    module + serialized collective schedule, all-gather the 32-byte
+    digest, and abort with a named schedule diff if any rank diverges.
+
+    ``program`` is a lowering (``jitted.lower(...)``), its module text,
+    or a zero-arg callable returning either (the callable form lets
+    :func:`initialize` run the preflight right after cluster formation,
+    when the global devices the lowering needs first exist).
+
+    The exchange is two-phase and tiny, and runs over the cluster
+    coordination service's key-value store — the same gRPC channel
+    cluster formation used, deliberately NOT an accelerator collective:
+    the divergence detector must never itself wedge in the mismatched
+    collective it exists to diagnose (and the CPU backend can't run
+    cross-process XLA computations at all).  One digest per rank on the
+    match path; only on a mismatch does a second exchange move the
+    serialized schedules so the error can name the first differing op —
+    rank 7's sign-compressed bucket surfaces as ``all-reduce(f32, ...)``
+    vs ``all-reduce(bf16, ...)``, not as a fleet-wide hang.  If the KV
+    client is unavailable the exchange falls back to a 32-byte
+    all-gather.  With one process the check degenerates to recording
+    the hashes (so the same code path runs in single-host tests and
+    utilities).  A peer that never reaches the barrier surfaces as
+    :class:`ClusterInitError` after ``APEX_TPU_PREFLIGHT_TIMEOUT_S``
+    (default 120).
+
+    Returns the per-rank record ``{label, rank, n_ranks, module_hash,
+    schedule_hash, n_collectives, ok}``; raises
+    :class:`SpmdPreflightError` on divergence."""
+    import numpy as np
+
+    from apex_tpu.analysis import spmd as spmd_mod
+
+    as_text = getattr(program, "as_text", None)
+    if callable(program) and not callable(as_text) \
+            and not isinstance(program, str):
+        program = program()
+        as_text = getattr(program, "as_text", None)
+    text = as_text() if callable(as_text) else program
+    if not isinstance(text, str):
+        raise TypeError(
+            "spmd_preflight expects a lowering, module text, or a "
+            f"zero-arg callable returning one; got {type(program).__name__}")
+
+    sched = spmd_mod.collective_schedule(text)
+    payload = spmd_mod.serialize_schedule(sched).encode("utf-8")
+    module_hash = hashlib.sha256(text.encode("utf-8")).hexdigest()
+    schedule_hash = hashlib.sha256(payload).hexdigest()
+    record = {"label": label, "rank": int(jax.process_index()),
+              "n_ranks": int(jax.process_count()),
+              "module_hash": module_hash, "schedule_hash": schedule_hash,
+              "n_collectives": len(sched), "ok": True}
+    if record["n_ranks"] <= 1:
+        return record
+
+    me, n = record["rank"], record["n_ranks"]
+    combined = hashlib.sha256(
+        (module_hash + schedule_hash).encode("utf-8")).hexdigest()
+    client = _kv_client()
+    if client is not None:
+        timeout_ms = max(
+            1000, int(_env_float("APEX_TPU_PREFLIGHT_TIMEOUT_S", 120.0)
+                      * 1000))
+        # the sequence number keeps repeated preflights (resilience
+        # rewinds re-run the barrier) from reading a stale round's keys;
+        # every rank calls symmetrically, so the counters agree
+        prefix = (f"apex_tpu/spmd_preflight/{label}/"
+                  f"{next(_PREFLIGHT_SEQ)}")
+        try:
+            client.key_value_set(f"{prefix}/digest/{me}", combined,
+                                 allow_overwrite=True)
+            digests = [client.blocking_key_value_get(
+                f"{prefix}/digest/{r}", timeout_ms) for r in range(n)]
+        except RuntimeError as e:
+            raise ClusterInitError(
+                f"SPMD preflight barrier for {label!r} timed out on rank "
+                f"{me}: a peer never published its schedule digest "
+                f"({e}).  Tune via APEX_TPU_PREFLIGHT_TIMEOUT_S."
+            ) from e
+        divergent = [r for r in range(n) if digests[r] != digests[0]]
+        if not divergent:
+            return record
+        # digest mismatch: move the schedules so the abort names ops
+        client.key_value_set(f"{prefix}/sched/{me}",
+                             payload.decode("utf-8"), allow_overwrite=True)
+        other = 0 if me in divergent else divergent[0]
+        try:
+            theirs = json.loads(client.blocking_key_value_get(
+                f"{prefix}/sched/{other}", timeout_ms))
+        except (RuntimeError, ValueError):
+            theirs = []
+    else:
+        # no coordination-service client (exotic init path): fall back
+        # to a 32-byte all-gather.  Safe even across diverging programs
+        # — the gather's own shape is rank-invariant by construction.
+        from jax.experimental import multihost_utils
+
+        digest = np.frombuffer(
+            hashlib.sha256(combined.encode("utf-8")).digest(),
+            dtype=np.uint8).copy()
+        rows = np.asarray(multihost_utils.process_allgather(digest))
+        divergent = [r for r in range(rows.shape[0])
+                     if not np.array_equal(rows[r], rows[0])]
+        if not divergent:
+            return record
+        lengths = np.asarray(multihost_utils.process_allgather(
+            np.asarray([len(payload)], dtype=np.int32)))
+        maxlen = int(lengths.max())
+        padded = np.zeros(maxlen, dtype=np.uint8)
+        padded[:len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+        payloads = np.asarray(multihost_utils.process_allgather(padded))
+        other = 0 if me in divergent else divergent[0]
+        try:
+            theirs = json.loads(bytes(
+                payloads[other][:int(lengths[other][0])]).decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            theirs = []
+    d = spmd_mod.first_divergence(json.loads(payload.decode("utf-8")),
+                                  theirs)
+    if d is None:
+        detail = (f"collective schedules agree ({len(sched)} op(s)) but "
+                  f"module hashes differ — the divergence is in "
+                  f"non-collective compute (this rank "
+                  f"{module_hash[:12]}, rank {other} differs)")
+    else:
+        i, mine_spell, theirs_spell = d
+        detail = (f"first differing collective is op #{i}: rank {me} "
+                  f"issues {mine_spell} but rank {other} issues "
+                  f"{theirs_spell}")
+    raise SpmdPreflightError(
+        f"SPMD preflight failed for {label!r}: rank(s) {divergent} "
+        f"lowered a program diverging from rank 0 — {detail}.  "
+        f"Aborting before the first step instead of deadlocking the "
+        f"fleet in a mismatched collective.")
 
 
 def _env_float(name: str, default: float) -> float:
@@ -46,7 +210,9 @@ def initialize(coordinator_address: Optional[str] = None,
                process_id: Optional[int] = None,
                timeout_s: Optional[float] = None,
                retries: Optional[int] = None,
-               backoff_s: Optional[float] = None) -> None:
+               backoff_s: Optional[float] = None,
+               preflight: Any = None,
+               preflight_label: str = "train_step") -> Optional[dict]:
     """Initialize multi-host JAX (the ``torch.distributed.launch`` /
     ``multiproc.py`` analog).
 
@@ -64,6 +230,17 @@ def initialize(coordinator_address: Optional[str] = None,
     (the r02 failure shape: a killed worker whose lease was never
     released) surfaces as a :class:`ClusterInitError` naming the ranks
     that can be missing, not as a wedged process.
+
+    ``preflight`` opts into the SPMD consistency barrier: a zero-arg
+    callable (invoked after cluster formation, when the global devices
+    exist) returning the lowering of the step this process is about to
+    run, or the lowering / module text itself.  Each rank hashes its
+    lowered module + collective schedule and cross-checks via one tiny
+    all-gather (:func:`spmd_preflight`); a divergent rank raises
+    :class:`SpmdPreflightError` naming the first differing collective
+    in both spellings, instead of wedging the fleet in the first
+    mismatched collective.  Returns the preflight record when the
+    barrier ran, else ``None``.
     """
     kwargs = {}
     addr = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
@@ -93,7 +270,6 @@ def initialize(coordinator_address: Optional[str] = None,
     for attempt in range(attempts):
         try:
             jax.distributed.initialize(**kwargs)
-            return
         except (RuntimeError, OSError, ValueError, jax.errors.JaxRuntimeError
                 ) as e:
             # a double-initialize is a programming error, not weather:
@@ -104,6 +280,12 @@ def initialize(coordinator_address: Optional[str] = None,
             last_error = e
             if attempt + 1 < attempts:
                 time.sleep(backoff_s * (2.0 ** attempt))
+            continue
+        # deliberately OUTSIDE the retry net: a preflight divergence is
+        # a program bug, not weather — retrying it re-diverges forever
+        if preflight is None:
+            return None
+        return spmd_preflight(preflight, label=preflight_label)
 
     n = kwargs.get("num_processes")
     r = kwargs.get("process_id")
@@ -130,6 +312,17 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _stderr_tail(path: str, limit: int = 2000) -> str:
+    """Last ``limit`` chars of a captured stderr file (the diagnosis a
+    dying rank left behind), or a placeholder when there is none."""
+    try:
+        with open(path, "r", errors="replace") as f:
+            data = f.read().strip()
+    except OSError:
+        return "<no stderr captured>"
+    return data[-limit:] if data else "<stderr empty>"
+
+
 def spawn(argslist: Sequence[str], world_size: Optional[int] = None,
           coordinator_port: Optional[int] = None,
           log_prefix: str = "PROC_") -> List[int]:
@@ -147,7 +340,11 @@ def spawn(argslist: Sequence[str], world_size: Optional[int] = None,
     impossible: the port is released before the coordinator re-binds it).
 
     If any worker exits non-zero, the remaining workers are terminated
-    rather than left blocking on cluster formation; the same cleanup
+    rather than left blocking on cluster formation, and a
+    :class:`ClusterInitError` is raised naming the first failing rank
+    WITH the tail of its captured stderr (every rank's stderr goes to
+    ``{log_prefix}{i}.err``) — a rank that died pre-barrier used to be
+    indistinguishable from one that never started.  The same cleanup
     (terminate, reap, close logs) runs if the launcher is interrupted or
     a launch step fails.
     """
@@ -166,6 +363,17 @@ def spawn(argslist: Sequence[str], world_size: Optional[int] = None,
 
     workers: List[subprocess.Popen] = []
     logs = []
+    err_paths: List[str] = []
+
+    def _raise_first_failure(codes: List[Optional[int]]) -> None:
+        bad = [i for i, c in enumerate(codes) if c not in (None, 0)]
+        first = bad[0]
+        raise ClusterInitError(
+            f"rank {first} exited with code {codes[first]} "
+            f"(failing ranks: {bad}; exit codes: {codes}).  "
+            f"rank {first} stderr tail ({err_paths[first]}):\n"
+            f"{_stderr_tail(err_paths[first])}")
+
     try:
         for i in range(world_size):
             env = dict(os.environ,
@@ -176,8 +384,14 @@ def spawn(argslist: Sequence[str], world_size: Optional[int] = None,
             if i != 0:
                 stdout = open(f"{log_prefix}{i}.log", "w")
                 logs.append(stdout)
+            # every rank's stderr is captured: a dying rank's traceback
+            # is the diagnosis the launcher surfaces
+            stderr = open(f"{log_prefix}{i}.err", "w")
+            logs.append(stderr)
+            err_paths.append(f"{log_prefix}{i}.err")
             workers.append(subprocess.Popen([sys.executable] + argslist,
-                                            stdout=stdout, env=env))
+                                            stdout=stdout, stderr=stderr,
+                                            env=env))
         # Poll rather than wait sequentially: a crashed rank would leave
         # the rest of the cluster blocked in jax.distributed.initialize
         # waiting for it — fail fast and tear the others down instead.
@@ -185,19 +399,21 @@ def spawn(argslist: Sequence[str], world_size: Optional[int] = None,
         while True:
             codes = [p.poll() for p in workers]
             if all(c is not None for c in codes):
+                if any(c != 0 for c in codes):
+                    _raise_first_failure(codes)
                 return codes
             if any(c not in (None, 0) for c in codes):
-                for p in workers:
-                    if p.poll() is None:
-                        p.terminate()
-                results = []
+                first_bad = list(codes)   # snapshot at detection time:
+                for p in workers:         # peers killed below get -15,
+                    if p.poll() is None:  # which must not masquerade as
+                        p.terminate()     # the original failure
                 for p in workers:  # timed: a SIGTERM-ignoring worker must
                     try:           # not wedge the fail-fast path
-                        results.append(p.wait(timeout=5))
+                        p.wait(timeout=5)
                     except subprocess.TimeoutExpired:
                         p.kill()
-                        results.append(p.wait())
-                return results
+                        p.wait()
+                _raise_first_failure(first_bad)
             time.sleep(0.2)
     finally:
         for p in workers:
@@ -220,7 +436,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print("usage: python -m apex_tpu.parallel.multiproc script.py ...",
               file=sys.stderr)
         return 2
-    codes = spawn(argv)
+    try:
+        codes = spawn(argv)
+    except ClusterInitError as e:
+        print(f"multiproc: {e}", file=sys.stderr)
+        return 1
     # a signal-killed worker has a negative returncode; never mask it
     return 0 if all(c == 0 for c in codes) else 1
 

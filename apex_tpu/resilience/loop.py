@@ -92,6 +92,14 @@ class ResilienceConfig:
     overflow_patience: int = 4         # K pinned-at-floor overflows
     sentinel_lag: int = 1              # steps to lag metric resolution
     incident_path: Optional[str] = None  # where watchdog/divergence artifacts go
+    #: opt-in SPMD preflight re-run after every rewind/reshape: called
+    #: as ``preflight(restored_state)`` before the loop resumes stepping
+    #: (wire it to :func:`apex_tpu.parallel.multiproc.spmd_preflight`
+    #: over the step's fresh lowering).  A fleet whose post-restore step
+    #: compiles a divergent collective schedule — the elastic shrink/
+    #: regrow hazard — aborts here with a named diff and an incident
+    #: artifact, instead of deadlocking on the first resumed step.
+    preflight: Optional[Callable[[Any], Any]] = None
 
 
 @dataclasses.dataclass
@@ -378,6 +386,19 @@ def run_resilient(
                 [reason], rewinds=rewinds)
             raise DivergenceError(f"{reason}; no checkpoint to rewind to")
         new_state = _reinit_scaler(new_state)
+        if cfg.preflight is not None:
+            try:
+                cfg.preflight(new_state)
+            except Exception as e:
+                _write_incident(
+                    "preflight-failed",
+                    f"post-rewind SPMD preflight rejected the restored "
+                    f"step (rewind to step {restored}): {e}",
+                    [reason, repr(e)] + events[-8:],
+                    rewinds=rewinds)
+                raise
+            events.append({"event": "preflight", "to_step": restored})
+            fr.note("preflight", to_step=restored)
         events.append({"event": "rewind", "to_step": restored,
                        "reason": reason, "rewind_count": rewinds})
         m_rewinds.inc()

@@ -22,6 +22,9 @@ WORKER = textwrap.dedent("""
     import os
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # the CPU backend only runs cross-process computations through the
+    # gloo collectives implementation
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     from apex_tpu.parallel import multiproc
     multiproc.initialize()   # picks up COORDINATOR_ADDRESS/WORLD_SIZE/RANK
     import jax.numpy as jnp

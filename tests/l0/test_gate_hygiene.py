@@ -1121,3 +1121,70 @@ def test_repo_profile_drift_validates():
     assert gate_hygiene._validate_profile_drifts(str(REPO)) == []
     assert sorted(REPO.glob("PROFILE_DRIFT_r*.json")), \
         "the profile-drift gate artifact must be committed"
+
+
+# ---------------------------------------------------------------------------
+# FLEETLINT_r*.json — the cross-rank SPMD consistency artifacts
+# ---------------------------------------------------------------------------
+
+def _valid_fleetlint():
+    rank = {"schedule_hash": "a" * 64, "opcode_hash": "b" * 64,
+            "n_collectives": 3}
+    return {"round": 1, "platform": "cpu", "n_ranks": 8,
+            "lanes": {"ddp_o1_train": {"compare": "schedule",
+                                       "consistent": True,
+                                       "ranks": {"0": dict(rank),
+                                                 "1": dict(rank)},
+                                       "mismatches": []}},
+            "gate": {"ok": True, "inconsistent_lanes": 0}}
+
+
+def test_committed_fleetlint_validated_against_schema(tmp_repo):
+    _analysis_module(tmp_repo, "fleetlint")
+    (tmp_repo / "FLEETLINT_r07.json").write_text('{"round": 7}')
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "bad fleet record")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("FLEETLINT_r07.json" in p
+               for p in verdict["invalid_fleetlints"])
+    assert gate_hygiene.main(["--repo", str(tmp_repo)]) == 1
+
+
+def test_fleetlint_contradictory_verdict_fails_hygiene(tmp_repo):
+    """A ``consistent`` lane verdict over disagreeing recorded per-rank
+    schedule hashes is the lie the schema exists to reject — "every rank
+    compiles the same collective schedule" must re-derive from the
+    recorded hashes, not be asserted."""
+    _analysis_module(tmp_repo, "fleetlint")
+    doc = _valid_fleetlint()
+    doc["lanes"]["ddp_o1_train"]["ranks"]["1"]["schedule_hash"] = "d" * 64
+    doc["lanes"]["ddp_o1_train"]["mismatches"] = [
+        {"ranks": ["0", "1"], "index": 0,
+         "a": "all-reduce(bf16)", "b": "all-reduce(f32)"}]
+    (tmp_repo / "FLEETLINT_r08.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "asserted fleet consistency")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("contradicts" in p for p in verdict["invalid_fleetlints"])
+
+
+def test_valid_fleetlint_passes_and_untracked_fails(tmp_repo):
+    _analysis_module(tmp_repo, "fleetlint")
+    (tmp_repo / "FLEETLINT_r09.json").write_text(
+        json.dumps(_valid_fleetlint()))
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]            # parked-but-untracked
+    assert verdict["untracked"] == ["FLEETLINT_r09.json"]
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "fleet round")
+    assert gate_hygiene.check(str(tmp_repo))["ok"]
+
+
+def test_repo_fleetlint_validates():
+    """The committed FLEETLINT_r01 is the schema's reference instance
+    (it rides the repo-level hygiene check in tier-1)."""
+    assert gate_hygiene._validate_fleetlints(str(REPO)) == []
+    assert sorted(REPO.glob("FLEETLINT_r*.json")), \
+        "the fleet SPMD gate artifact must be committed"

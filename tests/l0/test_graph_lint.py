@@ -277,9 +277,13 @@ def test_per_kind_budget_and_async_tally():
 """
     table = collective_table(hlo)
     assert table["all-reduce"] == {"count": 1, "bytes": 8 * 16 * 4,
-                                   "sync": 1, "async": 0}
+                                   "sync": 1, "async": 0,
+                                   "channels": [], "replica_groups": [],
+                                   "global_ids": 0}
     assert table["all-gather"] == {"count": 1, "bytes": 32 * 4,
-                                   "sync": 0, "async": 1}
+                                   "sync": 0, "async": 1,
+                                   "channels": [], "replica_groups": [],
+                                   "global_ids": 0}
     ctx = analysis.PassContext(stablehlo_text="", hlo_text=hlo)
     out = analysis.PASSES["collectives"](
         ctx, budget={"all-reduce": 4, "all-gather": 1 << 20})

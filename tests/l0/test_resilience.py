@@ -508,6 +508,53 @@ def test_managerless_non_ampstate_rewinds_from_memory_snapshot():
     assert np.all(np.isfinite(np.asarray(result.state["w"])))
 
 
+def test_preflight_runs_after_every_rewind(tmp_path):
+    """ISSUE 16: a rewind restores state whose re-lowered program may no
+    longer match the fleet (the restored step can reshape the mesh) —
+    the configured SPMD preflight must re-run after the restore, before
+    the loop resumes issuing collectives."""
+    a, step, state, batch = _workload()
+    inj = FaultInjector([NaNStorm(step=5, duration=6)])
+    mgr = DurableCheckpointManager(str(tmp_path))
+    calls = []
+    cfg = ResilienceConfig(checkpoint_every=3, overflow_patience=3,
+                           max_rewinds=2, watchdog_timeout_s=120.0,
+                           preflight=lambda st: calls.append(st))
+    result = run_resilient(step, state, batch, 18, amp_obj=a, manager=mgr,
+                           config=cfg, injector=inj)
+    assert result.rewinds == 1 and len(calls) == 1
+    # the preflight saw the RESTORED state, not the poisoned one
+    assert np.all(np.isfinite(
+        np.asarray(jax.tree.leaves(calls[0].master_params)[0])))
+    pf = [e for e in result.events if e["event"] == "preflight"]
+    assert pf and pf[0]["to_step"] == \
+        [e for e in result.events if e["event"] == "rewind"][0]["to_step"]
+
+
+def test_preflight_rejection_aborts_with_incident(tmp_path):
+    """A post-rewind preflight failure means the restored step would
+    deadlock the fleet: the loop must abort (re-raise) and leave a
+    machine-checkable incident naming the rejection — not resume."""
+    a, step, state, batch = _workload()
+    inj = FaultInjector([NaNStorm(step=5, duration=6)])
+    mgr = DurableCheckpointManager(str(tmp_path))
+    out = tmp_path / "INCIDENT_preflight.json"
+    cfg = ResilienceConfig(checkpoint_every=3, overflow_patience=3,
+                           max_rewinds=2, watchdog_timeout_s=120.0,
+                           incident_path=str(out),
+                           preflight=lambda st: (_ for _ in ()).throw(
+                               RuntimeError("rank 1 diverged: extra "
+                                            "all-reduce")))
+    with pytest.raises(RuntimeError, match="rank 1 diverged"):
+        run_resilient(step, state, batch, 18, amp_obj=a, manager=mgr,
+                      config=cfg, injector=inj)
+    rec = json.loads(out.read_text())
+    assert rec["status"] == "preflight-failed"
+    assert validate_incident(rec) == []
+    assert "post-rewind SPMD preflight rejected" in rec["summary"]
+    assert "rank 1 diverged" in rec["summary"]
+
+
 def test_run_without_faults_matches_plain_loop():
     """No faults, no checkpointing: run_resilient must be semantically
     transparent — same final state as the bare loop, bitwise."""

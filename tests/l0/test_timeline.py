@@ -54,10 +54,32 @@ def test_every_committed_family_has_an_adapter():
     for expect in ("BENCH", "KERNELBENCH", "MEMLINT", "PRECLINT",
                    "SCENARIO", "SERVE_DISAGG", "TRACE", "OBS",
                    "EXPORT", "CONVERGENCE", "DECODE_PROFILE",
-                   "DECODE_DECOMPOSE", "BENCH_VARIANCE"):
+                   "DECODE_DECOMPOSE", "BENCH_VARIANCE", "FLEETLINT"):
         assert expect in fams, f"{expect} not ingested ({fams})"
     assert all(rec["files"] for rec in out["coverage"].values())
     assert sum(rec["rows"] for rec in out["coverage"].values()) > 100
+
+
+def test_fleetlint_adapter_rows():
+    """FLEETLINT rounds chart per-lane consistency (1.0 = every rank
+    compiled the same collective schedule), the lane's collective count,
+    and the gate's inconsistent-lane total — a regression on any of them
+    is a fleet-wide deadlock risk appearing in the timeline."""
+    rank = {"schedule_hash": "a" * 64, "opcode_hash": "b" * 64,
+            "n_collectives": 4}
+    doc = {"round": 1, "platform": "cpu", "n_ranks": 8,
+           "lanes": {"ddp_o1_train": {"compare": "schedule",
+                                      "consistent": True,
+                                      "ranks": {"0": dict(rank),
+                                                "1": dict(
+                                                    rank,
+                                                    n_collectives=3)},
+                                      "mismatches": []}},
+           "gate": {"ok": True, "inconsistent_lanes": 0}}
+    rows = timeline.ADAPTERS["FLEETLINT"](doc, {})
+    assert ("ddp_o1_train", "consistent", 1.0) in rows
+    assert ("ddp_o1_train", "n_collectives", 4.0) in rows
+    assert ("gate", "inconsistent_lanes", 0.0) in rows
 
 
 def test_unknown_family_is_a_lint_error(tmp_path):

@@ -96,6 +96,14 @@ This check fails (exit 1) when
   first drift not naming the seeded bucket is CONTRADICTORY and
   schema-invalid) — the live drift tripwire's evidence is gate
   memory like the offline profiles, or
+- a committed ``FLEETLINT_r*.json`` does not validate against the
+  cross-rank SPMD lint schema (``apex_tpu/analysis/fleetlint.py``:
+  per-rank collective-schedule hashes, a ``consistent`` verdict that
+  RE-DERIVES from those hashes, mismatch rows naming the first
+  diverging op in both spellings, and a gate agreeing with its own
+  lanes — a contradictory fleet verdict is schema-invalid) — "every
+  rank compiles the same collective schedule" is gate memory, not
+  prose, or
 - a committed ``TIMELINE_r*.json`` does not validate against the
   timeline schema (``apex_tpu/analysis/timeline.py``: every
   regression row must cite a series whose recorded points actually
@@ -141,7 +149,7 @@ PATTERNS = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json",
             "CONVERGENCE_r*.json", "EXPORT_r*.json",
             "SERVE_DISAGG_r*.json", "SCENARIO_r*.json",
             "TRACE_r*.json", "TIMELINE_r*.json",
-            "PROFILE_DRIFT_r*.json")
+            "PROFILE_DRIFT_r*.json", "FLEETLINT_r*.json")
 
 #: Round-numbered incident artifacts additionally get schema-validated.
 INCIDENT_PATTERN = "INCIDENT_r*.json"
@@ -183,8 +191,11 @@ VARIANCE_PATTERN = "BENCH_VARIANCE_r*.json"
 #: ... and the longitudinal perf-timeline artifacts ...
 TIMELINE_PATTERN = "TIMELINE_r*.json"
 
-#: ... and the continuous-profile drift artifacts.
+#: ... and the continuous-profile drift artifacts ...
 PROFILE_DRIFT_PATTERN = "PROFILE_DRIFT_r*.json"
+
+#: ... and the cross-rank SPMD consistency artifacts.
+FLEETLINT_PATTERN = "FLEETLINT_r*.json"
 
 
 def _load_by_path(repo: str, *rel: str):
@@ -410,6 +421,21 @@ def _validate_profile_drifts(repo: str) -> "list[str]":
     return problems
 
 
+def _validate_fleetlints(repo: str) -> "list[str]":
+    """Schema problems over every present FLEETLINT_r*.json, as
+    ``path: problem`` strings (``apex_tpu/analysis/fleetlint.py`` —
+    which also re-derives every ``consistent`` verdict from the
+    recorded per-rank schedule hashes)."""
+    schema = _load_by_path(repo, "apex_tpu", "analysis", "fleetlint.py")
+    if schema is None:
+        return []
+    problems = []
+    for p in sorted(Path(repo).glob(FLEETLINT_PATTERN)):
+        for msg in schema.validate_fleetlint_file(str(p)):
+            problems.append(f"{p.name}: {msg}")
+    return problems
+
+
 def _git(repo: str, *args: str) -> "str | None":
     """stdout of a git command, or None when git/The repo is unavailable
     (the best-effort contract)."""
@@ -440,7 +466,7 @@ def check(repo: str = str(REPO)) -> dict:
                 "invalid_exports": [], "invalid_serve_disaggs": [],
                 "invalid_scenarios": [], "invalid_traces": [],
                 "invalid_variances": [], "invalid_timelines": [],
-                "invalid_profile_drifts": []}
+                "invalid_profile_drifts": [], "invalid_fleetlints": []}
     tracked = set(tracked_raw.split())
     missing = [f for f in REQUIRED
                if not (Path(repo) / f).exists() or f not in tracked]
@@ -474,13 +500,14 @@ def check(repo: str = str(REPO)) -> dict:
     invalid_var = _validate_variances(repo)
     invalid_tl = _validate_timelines(repo)
     invalid_pd = _validate_profile_drifts(repo)
+    invalid_fl = _validate_fleetlints(repo)
     return {"ok": not (missing or untracked or dirty or invalid
                        or invalid_mem or invalid_prec or invalid_dec
                        or invalid_obs or invalid_prof or invalid_conv
                        or invalid_exp or invalid_disagg
                        or invalid_scen or invalid_trace
                        or invalid_var or invalid_tl
-                       or invalid_pd),
+                       or invalid_pd or invalid_fl),
             "missing": missing, "untracked": untracked, "dirty": dirty,
             "invalid_incidents": invalid,
             "invalid_memlints": invalid_mem,
@@ -495,7 +522,8 @@ def check(repo: str = str(REPO)) -> dict:
             "invalid_traces": invalid_trace,
             "invalid_variances": invalid_var,
             "invalid_timelines": invalid_tl,
-            "invalid_profile_drifts": invalid_pd}
+            "invalid_profile_drifts": invalid_pd,
+            "invalid_fleetlints": invalid_fl}
 
 
 def main(argv=None) -> int:
@@ -528,7 +556,9 @@ def main(argv=None) -> int:
               f"invalid/stale timeline records "
               f"{verdict.get('invalid_timelines', [])}; invalid "
               f"profile-drift records "
-              f"{verdict.get('invalid_profile_drifts', [])}",
+              f"{verdict.get('invalid_profile_drifts', [])}; invalid "
+              f"fleetlint records "
+              f"{verdict.get('invalid_fleetlints', [])}",
               file=sys.stderr)
         return 1
     return 0

@@ -114,8 +114,13 @@ GRAPH_PASSES = ("donation", "sharding", "collectives", "constant-capture")
 #: sharing the lane's single lowering+compilation with the graph passes
 MEMLINT_PASSES = ("memory", "cost", "syncs")
 #: the precision-flow pass runs on every lane too (lowering-only; the
-#: lane's resolved amp policy rides in the PassContext)
-ALL_PASSES = GRAPH_PASSES + MEMLINT_PASSES + ("precision", "policy")
+#: lane's resolved amp policy rides in the PassContext), as does the
+#: SPMD deadlock-shape check (a collective under a rank-divergent
+#: predicate — trivially clean on single-chip lanes, load-bearing on
+#: the fleet lanes)
+ALL_PASSES = GRAPH_PASSES + MEMLINT_PASSES + ("precision",
+                                              "spmd-consistency",
+                                              "policy")
 
 #: train lanes the CLI can run (opt levels); decode rides separately.
 #: o4 = the fp8 regime (apex_tpu.quant): delayed-scaling state in the
@@ -513,6 +518,201 @@ def multichip_slice_table(n_devices: int = 8) -> dict:
     return out
 
 
+#: ranks the fleet lanes simulate: every rank of a data-parallel fleet
+#: lowers the SAME program, so each lane lowers the step once per rank
+#: on the virtual mesh and cross-checks the collective schedules —
+#: exactly what the runtime preflight
+#: (:func:`apex_tpu.parallel.multiproc.spmd_preflight`) does with an
+#: all-gather on a real cluster.
+FLEET_RANKS = 8
+
+#: fleet lanes: the DDP O1/O2 train steps (per-rank schedule
+#: consistency + the conditional-collective deadlock check) and the
+#: elastic reshape pair (8→4 shrink / 4→8 regrow — the
+#: DurableCheckpointManager reshape lanes, which must stay
+#: opcode-consistent even though groups/bytes legally change).
+FLEET_LANES = ("ddp_o1_train", "ddp_o2_train",
+               "reshape_8to4", "reshape_4to8")
+
+
+def build_fleet_step(opt_level: str = "O1", n_devices: int = 8):
+    """(jitted_step, example_args, properties): the DDP + amp train
+    step under ``shard_map`` on the first ``n_devices`` of the virtual
+    mesh — the program every rank of a data-parallel fleet compiles
+    (grads reduced through ``DistributedDataParallel.reduce``, loss
+    ``pmean``-ed, so the lowering carries the fleet's real collective
+    schedule)."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu.parallel import DistributedDataParallel
+    from apex_tpu.utils.jax_compat import shard_map
+
+    devices = jax.devices("cpu")[:n_devices]
+    if len(devices) < n_devices:
+        # same hazard as multichip_slice_table: a mesh missing devices
+        # would silently lower a different (smaller) schedule
+        raise RuntimeError(
+            f"need {n_devices} CPU devices for the fleet lanes, have "
+            f"{len(devices)}; run tools/graph_lint.py as the entry "
+            f"point so xla_force_host_platform_device_count applies")
+    mesh = Mesh(np.array(devices), ("data",))
+    params = {"w1": jax.random.normal(jax.random.PRNGKey(0), (8, 16)),
+              "w2": jax.random.normal(jax.random.PRNGKey(1), (16, 8))}
+
+    def loss_fn(p, xb):
+        h = jax.nn.relu(xb @ p["w1"])
+        return jnp.mean(jnp.square(h @ p["w2"]))
+
+    ddp = DistributedDataParallel(axis_name="data")
+    a = amp.initialize(optimizer=FusedAdam(lr=1e-3),
+                       opt_level=opt_level, verbosity=0)
+    state = a.init(params)
+    step = amp.make_train_step(a, loss_fn, axis_name="data",
+                               reduce_fn=ddp.reduce)
+
+    def inner(s, xb):
+        s2, m = step(s, xb[0])
+        return s2, jax.lax.pmean(m["loss"], "data")
+
+    fn = jax.jit(shard_map(inner, mesh=mesh,
+                           in_specs=(P(), P("data")),
+                           out_specs=(P(), P())))
+    x = jax.random.normal(jax.random.PRNGKey(2), (n_devices, 4, 8))
+    return fn, (state, x), a.properties
+
+
+def _fleet_rank_schedule(opt_level: str, n_devices: int):
+    """(stablehlo_text, collective_schedule) of one rank's lowering."""
+    from apex_tpu.analysis import spmd as spmd_mod
+
+    fn, args, _props = build_fleet_step(opt_level, n_devices)
+    text = analysis.lower_quiet(fn, *args).as_text()
+    return text, spmd_mod.collective_schedule(text)
+
+
+def fleet_lane_result(lane: str, n_ranks: int = FLEET_RANKS):
+    """(findings, lane_record) for one fleet lane — the shared core of
+    :func:`lint_fleet` (CLI verdict) and :func:`emit_fleetlint` (the
+    committed artifact), so the two can never diverge.  ``lane_record``
+    matches the FLEETLINT schema's per-lane shape
+    (:mod:`apex_tpu.analysis.fleetlint`), its ``consistent`` verdict
+    re-derivable from the recorded per-rank hashes."""
+    from apex_tpu.analysis import spmd as spmd_mod
+
+    findings = []
+    mismatches = []
+    if lane in ("ddp_o1_train", "ddp_o2_train"):
+        opt = lane.split("_")[1].upper()
+        compare, div_keys = "schedule", spmd_mod._IDENTITY_KEYS
+        scheds = {}
+        ref_text = None
+        for r in range(n_ranks):
+            text, sched = _fleet_rank_schedule(opt, 8)
+            if ref_text is None:
+                ref_text = text
+            scheds[str(r)] = sched
+        findings.extend(spmd_mod.conditional_collective_findings(ref_text))
+    elif lane in ("reshape_8to4", "reshape_4to8"):
+        compare, div_keys = "opcodes", ("kind", "variant")
+        text8, s8 = _fleet_rank_schedule("O2", 8)
+        text4, s4 = _fleet_rank_schedule("O2", 4)
+        scheds = {"mesh8": s8, "mesh4": s4} if lane == "reshape_8to4" \
+            else {"mesh4": s4, "mesh8": s8}
+        findings.extend(spmd_mod.conditional_collective_findings(
+            text8 if lane == "reshape_8to4" else text4))
+    else:
+        raise KeyError(f"unknown fleet lane {lane!r}; have {FLEET_LANES}")
+
+    labels = list(scheds)
+    ref = labels[0]
+    for lbl in labels[1:]:
+        if compare == "schedule":
+            findings.extend(spmd_mod.diff_schedules(
+                f"rank {ref}", scheds[ref], f"rank {lbl}", scheds[lbl]))
+        else:
+            findings.extend(spmd_mod.reshape_pair_findings(
+                ref, scheds[ref], lbl, scheds[lbl]))
+        d = spmd_mod.first_divergence(scheds[ref], scheds[lbl], div_keys)
+        if d is not None:
+            mismatches.append({"ranks": [ref, lbl], "index": d[0],
+                               "a": d[1], "b": d[2]})
+
+    ranks = {
+        lbl: {"schedule_hash": spmd_mod.schedule_fingerprint(s),
+              "opcode_hash": spmd_mod.schedule_fingerprint(
+                  s, opcodes_only=True),
+              "n_collectives": len(s)}
+        for lbl, s in scheds.items()}
+    key = "schedule_hash" if compare == "schedule" else "opcode_hash"
+    consistent = len({rec[key] for rec in ranks.values()}) == 1
+    if compare == "schedule" and consistent:
+        findings.append(analysis.Finding(
+            "spmd-consistency", "info",
+            f"{len(ranks)} per-rank lowerings schedule-consistent "
+            f"({ranks[ref]['n_collectives']} collective(s), fingerprint "
+            f"{ranks[ref]['schedule_hash'][:12]})",
+            op="fleet", count=len(ranks)))
+    return findings, {"compare": compare, "consistent": consistent,
+                      "ranks": ranks, "mismatches": mismatches}
+
+
+def lint_fleet(lane: str, passes=None, n_ranks: int = FLEET_RANKS,
+               _collect=None):
+    """Lint one fleet lane: per-rank lowerings of the DDP train step
+    (or the reshape pair) diffed for SPMD schedule consistency.  Only
+    the ``spmd-consistency`` pass applies — any other requested pass
+    set skips the lane."""
+    from apex_tpu.analysis.report import make_report
+
+    if passes is not None and "spmd-consistency" not in passes:
+        return analysis.Report()
+    findings, rec = fleet_lane_result(lane, n_ranks=n_ranks)
+    report = make_report(findings, ("spmd-consistency",))
+    if _collect is not None:
+        counts: dict = {}
+        for f in findings:
+            counts[f.severity] = counts.get(f.severity, 0) + 1
+        _collect[lane] = dict(rec, findings=counts)
+    return report
+
+
+def emit_fleetlint(path: str, verbose: bool = False) -> int:
+    """Write the FLEETLINT artifact: every fleet lane's per-rank
+    schedule fingerprints, mismatch rows naming the first diverging op,
+    and the re-derivable gate verdict.  Returns the number of error
+    findings across all lanes."""
+    lanes: dict = {}
+    n_errors = 0
+    for lane in FLEET_LANES:
+        findings, rec = fleet_lane_result(lane)
+        counts: dict = {}
+        for f in findings:
+            counts[f.severity] = counts.get(f.severity, 0) + 1
+        lanes[lane] = dict(rec, findings=counts)
+        n_errors += counts.get("error", 0)
+        if verbose or counts.get("error", 0):
+            print(f"--- {lane} ---", file=sys.stderr)
+            for f in findings:
+                print(f"  [{f.severity}] {f.op}: {f.message}",
+                      file=sys.stderr)
+    bad = sorted(n for n, rec in lanes.items() if not rec["consistent"])
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    doc = {
+        "round": int(m.group(1)) if m else 0,
+        "platform": jax.devices()[0].platform,
+        "n_ranks": FLEET_RANKS,
+        "lanes": lanes,
+        "gate": {"ok": not bad, "inconsistent_lanes": len(bad)},
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"fleetlint artifact written: {path} ({len(lanes)} lanes)",
+          file=sys.stderr)
+    return n_errors
+
+
 def _calibration_audit() -> "list":
     """Gate-calibration findings: committed KERNELBENCH/BENCH floors
     and measurements vs the cost-model ceilings.  An unimportable
@@ -699,10 +899,11 @@ def main(argv=None) -> int:
     ap.add_argument("--passes", default=",".join(ALL_PASSES),
                     help=f"comma list from {ALL_PASSES}")
     ap.add_argument("--lanes", default=None,
-                    help="comma list from o0,o1,o2,o3,o4,decode,serve "
-                         "(train opt levels incl. the fp8 O4 regime + "
-                         "the decode lanes [decode_b1_kv8 = int8 KV] + "
-                         "the serve-engine step); default "
+                    help="comma list from o0,o1,o2,o3,o4,decode,serve,"
+                         "fleet (train opt levels incl. the fp8 O4 "
+                         "regime + the decode lanes [decode_b1_kv8 = "
+                         "int8 KV] + the serve-engine step + the "
+                         "cross-rank SPMD fleet lanes); default "
                          "o1,decode,serve — except --passes precision, "
                          "whose contract is the full O0–O4 matrix, "
                          "where the default is "
@@ -717,14 +918,18 @@ def main(argv=None) -> int:
                     help="arm the per-device peak-HBM gate (bare flag "
                          "= v5e 16 GiB; 512MiB / 2GiB forms accepted)")
     ap.add_argument("--emit-json", default=None,
-                    metavar="MEMLINT_rN.json|PRECLINT_rN.json",
+                    metavar="MEMLINT_rN.json|PRECLINT_rN.json|"
+                            "FLEETLINT_rN.json",
                     help="write a committed lint artifact, dispatched "
                          "on the file name: MEMLINT_r*.json = all "
                          "passes over O1+O2 train + decode + serve + "
                          "multichip slices + calibration audit; "
                          "PRECLINT_r*.json = the precision pass over "
                          "every O0–O4 train lane + decode + serve "
-                         "(lowering only)")
+                         "(lowering only); FLEETLINT_r*.json = the "
+                         "cross-rank SPMD consistency lanes (per-rank "
+                         "DDP O1/O2 schedules + the reshape pair, "
+                         "lowering only)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print every finding, not just errors")
     opts = ap.parse_args(argv)
@@ -743,11 +948,12 @@ def main(argv=None) -> int:
     if unknown:
         ap.error(f"unknown families {unknown}; have {FAMILIES}")
     bad_lanes = [x for x in lanes
-                 if x not in TRAIN_LANES + ("decode", "serve")]
+                 if x not in TRAIN_LANES + ("decode", "serve", "fleet")]
     if bad_lanes or not lanes:
         ap.error(f"unknown lanes {bad_lanes or opts.lanes!r}; have "
-                 f"{', '.join(TRAIN_LANES)}, decode, serve — a typo'd "
-                 f"lane list must not pass the gate by linting nothing")
+                 f"{', '.join(TRAIN_LANES)}, decode, serve, fleet — a "
+                 f"typo'd lane list must not pass the gate by linting "
+                 f"nothing")
     try:
         budget = parse_bytes(opts.memory_budget) \
             if opts.memory_budget is not None else None
@@ -763,7 +969,8 @@ def main(argv=None) -> int:
     # PRECLINT artifact path does — but an armed memory budget with no
     # memory pass requested must be refused, not silently unasserted
     lowering_only = set(passes) <= {"precision", "policy",
-                                    "constant-capture", "export-compat"}
+                                    "constant-capture", "export-compat",
+                                    "spmd-consistency"}
     if lowering_only and budget is not None:
         ap.error("--memory-budget needs the memory pass; the requested "
                  f"--passes {','.join(passes)} never reads it (an "
@@ -773,6 +980,36 @@ def main(argv=None) -> int:
         # (not under --emit-json: the artifact branches own their
         # compile story and their --passes diagnostics)
         opts.no_compile = True
+
+    if opts.emit_json and \
+            os.path.basename(opts.emit_json).startswith("FLEETLINT"):
+        # the fleet artifact's contract is every fleet lane under the
+        # spmd-consistency pass alone — a restricted run must be
+        # refused, never silently committed as a full document
+        if passes not in (ALL_PASSES, ("spmd-consistency",)):
+            ap.error("--emit-json FLEETLINT_r*.json runs exactly the "
+                     "spmd-consistency pass over the fleet lanes; drop "
+                     "--passes (or pass --passes spmd-consistency)")
+        if tuple(families) != FAMILIES:
+            ap.error("--families does not apply to the fleet lanes "
+                     "(they lower the DDP step, not a model family); "
+                     "drop --families")
+        if lanes_explicit and lanes != ["fleet"]:
+            ap.error("--emit-json FLEETLINT_r*.json always writes "
+                     "every fleet lane; drop --lanes (or pass "
+                     "--lanes fleet)")
+        if budget is not None:
+            ap.error("--memory-budget does not apply to the fleet "
+                     "artifact (lowering-only; no compiled memory "
+                     "analysis) — an armed budget that asserts "
+                     "nothing must not pass the gate")
+        n_errors = emit_fleetlint(opts.emit_json, verbose=opts.verbose)
+        if n_errors:
+            print(f"graph lint FAILED: {n_errors} SPMD consistency "
+                  f"error finding(s) — see the artifact",
+                  file=sys.stderr)
+            return 1
+        return 0
 
     if opts.emit_json and \
             os.path.basename(opts.emit_json).startswith("PRECLINT"):
@@ -887,6 +1124,9 @@ def main(argv=None) -> int:
             run(lane, lambda ln=lane: lint_serve_verify(
                 ln, passes=passes, compile=not opts.no_compile,
                 memory_budget=budget))
+    if "fleet" in lanes:
+        for lane in FLEET_LANES:
+            run(lane, lambda ln=lane: lint_fleet(ln, passes=passes))
     if failed:
         print(f"graph lint FAILED for: {failed}", file=sys.stderr)
         return 1
