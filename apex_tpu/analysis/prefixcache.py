@@ -1,0 +1,306 @@
+"""PREFIXCACHE_r*.json — the cross-request prefix-sharing gate
+artifact and its contradiction-rejecting schema.
+
+The serve engine's prefix cache (``apex_tpu/serve/paged.py`` /
+``scheduler.py``) deduplicates KV across requests: content-addressed
+blocks are shared by refcount, a full-prompt match forks copy-on-write,
+and a hit request skips prefill for the matched span.  The claim worth
+committing is an A/B over the SAME shared-system-prompt c16 stream —
+sharing on vs sharing off at equal devices and equal requests:
+
+- the sharing arm dispatches FEWER prefill tokens (work actually
+  skipped, counted in deterministic tokens, not wall time), and
+- the sharing arm admits MORE requests per resident block (the pool
+  deduplication — same stream, smaller peak block footprint), and
+- every streamed output stays BITWISE equal to solo ``generate()``
+  (sharing is a perf optimization, never a fidelity trade).
+
+Contradiction rejection, like every gate schema in this family: the
+headline numbers must RE-DERIVE from the per-request spans the
+scheduler recorded (``prefix_events``), and the gate verdict must
+re-derive from the recorded numbers — a typed-in "ok", a hit rate the
+spans refute, or a skipped-token total the spans don't add up to is
+schema-invalid.  ``tools/gate_hygiene.py`` loads this module by file
+path in tier-1, so the module stays **stdlib-only** (no jax import).
+
+Document shape::
+
+    {
+      "round": 1,
+      "platform": "cpu",
+      "config": {"model": "gpt_tiny", "concurrency": 16,
+                 "system_prompt_tokens": 32, "prefill": 64,
+                 "new_tokens": 16, "block_size": 4},
+      "sharing": {                       # prefix_cache=True arm
+        "prefill_chunks": 34,            # fixed-size chunks dispatched
+        "prefill_tokens_dispatched": 268,
+        "admitted_requests": 16,
+        "peak_live_blocks": 40,          # max allocator.live_count
+        "admitted_requests_per_block": 0.4,
+        "p50_ms": 1.9, "p99_ms": 3.2,    # engine's own histogram
+        "retraces": 1,                   # decode executables minted
+        "prefix": {
+          "probes": 16, "hits": 15, "hit_rate": 0.9375,
+          "hit_tokens": 480,             # tokens NOT re-prefilled
+          "cow_copies": 1, "shared_blocks_peak": 8,
+          "cached_evictions": 0,
+          "requests": [                  # the scheduler's own spans
+            {"uid": "c0", "prompt_len": 64, "matched": 0,
+             "dispatched": 64}, ...]
+        }
+      },
+      "baseline": {                      # prefix_cache=False arm
+        "prefill_chunks": 128, "prefill_tokens_dispatched": 748,
+        "admitted_requests": 16, "peak_live_blocks": 52,
+        "admitted_requests_per_block": 0.307,
+        "p50_ms": 1.8, "p99_ms": 3.1, "retraces": 1
+      },
+      "bitwise_ok": true,                # both arms vs solo generate()
+      "gate": {"hit_rate_ok": true, "ab_ok": true,
+               "bitwise_ok": true, "ok": true},
+      "note": "..."
+    }
+
+Span semantics (what the scheduler records per admission):
+``matched`` is the prefix length satisfied from the content index
+(block-aligned; ``prompt_len`` itself on a full-prompt CoW match) and
+``dispatched`` is what prefill actually re-ran — ``prompt_len -
+matched``, floored at 1 because a full match still re-dispatches ONE
+token through the CoW rewrite.  So ``dispatched == max(prompt_len -
+matched, 1)`` per span, ``hit_tokens == Σ (prompt_len - dispatched)``,
+and ``hits``/``probes``/``hit_rate`` count the spans directly.
+
+Gate derivations the validator enforces:
+
+- ``gate.hit_rate_ok == (prefix.hit_rate > 0)``;
+- ``gate.ab_ok`` == sharing dispatched FEWER prefill tokens AND
+  admitted MORE requests per block AND both arms stayed at one decode
+  trace (``retraces == 1`` — sharing must not mint executables);
+- ``gate.bitwise_ok == bitwise_ok``;
+- ``gate.ok == hit_rate_ok and ab_ok and bitwise_ok``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+#: tolerance for re-derived ratios (hit_rate, requests-per-block)
+_TOL = 1e-6
+
+
+def _num(x) -> bool:
+    return isinstance(x, (int, float)) and not isinstance(x, bool)
+
+
+def _check_arm(name: str, arm, problems: List[str]) -> bool:
+    """Structural fields every arm record carries; True when usable."""
+    if not isinstance(arm, dict):
+        problems.append(f"missing/invalid '{name}' arm (object)")
+        return False
+    ok = True
+    for field in ("prefill_chunks", "prefill_tokens_dispatched",
+                  "admitted_requests", "peak_live_blocks", "retraces"):
+        if not isinstance(arm.get(field), int) or arm[field] < 0:
+            problems.append(f"{name}.{field} missing (int >= 0)")
+            ok = False
+    for field in ("admitted_requests_per_block", "p50_ms", "p99_ms"):
+        if not _num(arm.get(field)) or arm[field] < 0:
+            problems.append(f"{name}.{field} missing (number >= 0)")
+            ok = False
+    if not ok:
+        return False
+    blocks = max(arm["peak_live_blocks"], 1)
+    derived = arm["admitted_requests"] / blocks
+    if abs(arm["admitted_requests_per_block"] - derived) > 1e-4:
+        problems.append(
+            f"CONTRADICTORY record: {name}.admitted_requests_per_block="
+            f"{arm['admitted_requests_per_block']} but "
+            f"admitted_requests/peak_live_blocks derives "
+            f"{round(derived, 6)}")
+    return True
+
+
+def _check_prefix(prefix, problems: List[str]) -> bool:
+    """The sharing arm's prefix block: headline counters must re-derive
+    from the recorded per-request spans."""
+    if not isinstance(prefix, dict):
+        problems.append("missing/invalid 'sharing.prefix' (object)")
+        return False
+    ok = True
+    for field in ("probes", "hits", "hit_tokens", "cow_copies",
+                  "shared_blocks_peak", "cached_evictions"):
+        if not isinstance(prefix.get(field), int) or prefix[field] < 0:
+            problems.append(f"sharing.prefix.{field} missing (int >= 0)")
+            ok = False
+    if not _num(prefix.get("hit_rate")) or \
+            not 0.0 <= prefix["hit_rate"] <= 1.0:
+        problems.append("sharing.prefix.hit_rate missing (number in "
+                        "[0, 1])")
+        ok = False
+    reqs = prefix.get("requests")
+    if not isinstance(reqs, list) or not reqs:
+        problems.append("sharing.prefix.requests missing/empty (the "
+                        "per-request spans the headline counters must "
+                        "re-derive from)")
+        ok = False
+    if not ok:
+        return False
+
+    hits = skipped = matched_total = 0
+    for i, r in enumerate(reqs):
+        if not isinstance(r, dict) or \
+                not isinstance(r.get("uid"), str) or \
+                not isinstance(r.get("prompt_len"), int) or \
+                not isinstance(r.get("matched"), int) or \
+                not isinstance(r.get("dispatched"), int):
+            problems.append(
+                f"sharing.prefix.requests[{i}] needs uid (str) + "
+                f"prompt_len/matched/dispatched (int)")
+            return False
+        n, m, d = r["prompt_len"], r["matched"], r["dispatched"]
+        if not (0 <= m <= n) or d != max(n - m, 1):
+            problems.append(
+                f"CONTRADICTORY record: sharing.prefix.requests[{i}] "
+                f"({r['uid']!r}) states prompt_len={n} matched={m} "
+                f"dispatched={d}, but dispatched must equal "
+                f"max(prompt_len - matched, 1) — a full match still "
+                f"re-dispatches one token through the CoW rewrite")
+            return False
+        matched_total += m
+        if m > 0:
+            hits += 1
+        skipped += n - d
+    if prefix["probes"] != len(reqs):
+        problems.append(
+            f"CONTRADICTORY record: sharing.prefix.probes="
+            f"{prefix['probes']} but {len(reqs)} request span(s) are "
+            f"recorded — every admission probes exactly once")
+    if prefix["hits"] != hits:
+        problems.append(
+            f"CONTRADICTORY record: sharing.prefix.hits="
+            f"{prefix['hits']} but the recorded spans derive {hits} "
+            f"(matched > 0)")
+    derived_rate = hits / max(len(reqs), 1)
+    if abs(prefix["hit_rate"] - derived_rate) > _TOL:
+        problems.append(
+            f"CONTRADICTORY record: sharing.prefix.hit_rate="
+            f"{prefix['hit_rate']} but the recorded spans derive "
+            f"{round(derived_rate, 6)}")
+    if prefix["hit_tokens"] != skipped:
+        problems.append(
+            f"CONTRADICTORY record: sharing.prefix.hit_tokens="
+            f"{prefix['hit_tokens']} but the recorded spans derive "
+            f"{skipped} skipped prefill tokens "
+            f"(Σ prompt_len - dispatched)")
+    return True
+
+
+def validate_prefixcache(doc) -> List[str]:
+    """Problems with one parsed PREFIXCACHE document (empty = valid)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not a JSON object"]
+    if not isinstance(doc.get("round"), int):
+        problems.append("missing/invalid 'round' (int)")
+    if not isinstance(doc.get("platform"), str):
+        problems.append("missing/invalid 'platform' (str)")
+
+    cfg = doc.get("config")
+    if not isinstance(cfg, dict):
+        problems.append("missing/invalid 'config' (object)")
+    else:
+        for field in ("concurrency", "system_prompt_tokens", "prefill",
+                      "new_tokens", "block_size"):
+            if not isinstance(cfg.get(field), int) or cfg[field] <= 0:
+                problems.append(f"config.{field} missing (int > 0)")
+        if not isinstance(cfg.get("model"), str):
+            problems.append("config.model missing (str)")
+
+    sharing_ok = _check_arm("sharing", doc.get("sharing"), problems)
+    baseline_ok = _check_arm("baseline", doc.get("baseline"), problems)
+    prefix_ok = sharing_ok and _check_prefix(
+        doc["sharing"].get("prefix"), problems)
+
+    if not isinstance(doc.get("bitwise_ok"), bool):
+        problems.append("missing/invalid 'bitwise_ok' (bool)")
+
+    # -- arms must describe the SAME offered stream --------------------
+    if sharing_ok and baseline_ok:
+        sh, bl = doc["sharing"], doc["baseline"]
+        if sh["admitted_requests"] != bl["admitted_requests"]:
+            problems.append(
+                f"CONTRADICTORY record: arms admitted different "
+                f"request counts ({sh['admitted_requests']} vs "
+                f"{bl['admitted_requests']}) — the A/B must run the "
+                f"same stream")
+        if prefix_ok and \
+                sh["admitted_requests"] != doc["sharing"]["prefix"][
+                    "probes"]:
+            problems.append(
+                f"CONTRADICTORY record: sharing arm admitted "
+                f"{sh['admitted_requests']} request(s) but recorded "
+                f"{doc['sharing']['prefix']['probes']} probe span(s)")
+        if prefix_ok:
+            dispatched = sum(r["dispatched"] for r in
+                             doc["sharing"]["prefix"]["requests"])
+            if sh["prefill_tokens_dispatched"] != dispatched:
+                problems.append(
+                    f"CONTRADICTORY record: "
+                    f"sharing.prefill_tokens_dispatched="
+                    f"{sh['prefill_tokens_dispatched']} but the "
+                    f"recorded spans derive {dispatched}")
+
+    gate = doc.get("gate")
+    if not isinstance(gate, dict) or not all(
+            isinstance(gate.get(k), bool)
+            for k in ("hit_rate_ok", "ab_ok", "bitwise_ok", "ok")):
+        problems.append("missing/invalid 'gate' (hit_rate_ok + ab_ok + "
+                        "bitwise_ok + ok bools)")
+        return problems
+
+    # -- the verdict must re-derive from the recorded numbers ----------
+    if prefix_ok:
+        derived = doc["sharing"]["prefix"]["hit_rate"] > 0.0
+        if gate["hit_rate_ok"] != derived:
+            problems.append(
+                f"CONTRADICTORY verdict: gate.hit_rate_ok="
+                f"{gate['hit_rate_ok']} but the recorded hit rate "
+                f"derives {derived}")
+    if sharing_ok and baseline_ok:
+        sh, bl = doc["sharing"], doc["baseline"]
+        derived_ab = (
+            sh["prefill_tokens_dispatched"]
+            < bl["prefill_tokens_dispatched"]
+            and sh["admitted_requests_per_block"]
+            > bl["admitted_requests_per_block"]
+            and sh["retraces"] == 1 and bl["retraces"] == 1)
+        if gate["ab_ok"] != derived_ab:
+            problems.append(
+                f"CONTRADICTORY verdict: gate.ab_ok={gate['ab_ok']} "
+                f"but the recorded arms derive {derived_ab} (fewer "
+                f"prefill tokens + more requests per block + one "
+                f"decode trace each)")
+    if isinstance(doc.get("bitwise_ok"), bool) and \
+            gate["bitwise_ok"] != doc["bitwise_ok"]:
+        problems.append(
+            f"CONTRADICTORY verdict: gate.bitwise_ok="
+            f"{gate['bitwise_ok']} but the document records "
+            f"bitwise_ok={doc['bitwise_ok']}")
+    derived_ok = gate["hit_rate_ok"] and gate["ab_ok"] \
+        and gate["bitwise_ok"]
+    if gate["ok"] != derived_ok:
+        problems.append(
+            f"CONTRADICTORY verdict: gate.ok={gate['ok']} but its own "
+            f"components derive {derived_ok}")
+    return problems
+
+
+def validate_prefixcache_file(path: str) -> List[str]:
+    """Problems with one PREFIXCACHE_r*.json file (empty = valid)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable prefixcache JSON: {e}"]
+    return validate_prefixcache(doc)

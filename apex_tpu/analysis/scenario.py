@@ -42,6 +42,8 @@ Document shape::
           "decode_steps": ..., "decode_tokens": ...,
           "tokens_per_step": ..., "retraces": 1, "preemptions": 0,
           "acceptance_rate": 0.62,           # spec cells only
+          "prefix": {"probes": 4, "hits": 3,  # optional: engines with
+                     "hit_rate": 0.75},       # the prefix cache on
           "gate": {"tail_ok": true, "retrace_ok": true, "ok": true}
         }, ...
       },
@@ -100,6 +102,28 @@ def _check_slo_block(name: str, slo, problems: List[str]):
 
 def _num(x) -> bool:
     return isinstance(x, (int, float)) and not isinstance(x, bool)
+
+
+def _check_prefix_block(name: str, prefix, problems: List[str]):
+    """Validate one optional per-cell prefix-sharing block: the hit
+    rate must RE-DERIVE from the recorded probe/hit counts (the
+    PREFIXCACHE_r*.json discipline at cell granularity)."""
+    if not isinstance(prefix, dict) or \
+            not isinstance(prefix.get("probes"), int) or \
+            not isinstance(prefix.get("hits"), int) or \
+            not _num(prefix.get("hit_rate")):
+        problems.append(f"{name} must carry probes/hits ints and a "
+                        f"hit_rate number")
+        return
+    if not 0 <= prefix["hits"] <= prefix["probes"]:
+        problems.append(f"{name}: hits {prefix['hits']} outside "
+                        f"[0, probes={prefix['probes']}]")
+        return
+    derived = round(prefix["hits"] / max(prefix["probes"], 1), 6)
+    if abs(prefix["hit_rate"] - derived) > 1e-6:
+        problems.append(
+            f"CONTRADICTORY record: {name}.hit_rate="
+            f"{prefix['hit_rate']} but hits/probes derives {derived}")
 
 
 def _check_cell(name: str, cell, gate_k, problems: List[str]):
@@ -183,6 +207,9 @@ def _check_cell(name: str, cell, gate_k, problems: List[str]):
             f"retrace_ok={gate['retrace_ok']}")
     if cell.get("slo") is not None:
         _check_slo_block(f"cells[{name}].slo", cell["slo"], problems)
+    if cell.get("prefix") is not None:
+        _check_prefix_block(f"cells[{name}].prefix", cell["prefix"],
+                            problems)
     return gate["ok"], cell["tokens_per_step"]
 
 

@@ -306,6 +306,33 @@ def _ingest_fleetlint(doc, prev) -> List[Row]:
     return rows
 
 
+@adapter("PREFIXCACHE")
+def _ingest_prefixcache(doc, prev) -> List[Row]:
+    """Prefix-sharing rounds: per-arm deterministic counts (prefill
+    tokens dispatched, resident-block footprint) plus the hit-rate
+    headline — the longitudinal record of what KV dedup saves."""
+    rows: List[Row] = []
+    for arm in ("sharing", "baseline"):
+        rec = doc.get(arm)
+        if not isinstance(rec, dict):
+            continue
+        rows.extend((arm, k, float(rec[k]))
+                    for k in ("prefill_chunks",
+                              "prefill_tokens_dispatched",
+                              "peak_live_blocks",
+                              "admitted_requests_per_block",
+                              "tok_s", "p50_ms", "p99_ms")
+                    if _num(rec.get(k)))
+    sharing = doc.get("sharing")
+    prefix = sharing.get("prefix") if isinstance(sharing, dict) else None
+    if isinstance(prefix, dict):
+        rows.extend(("prefix", k, float(prefix[k]))
+                    for k in ("hit_rate", "hit_tokens", "cow_copies",
+                              "shared_blocks_peak")
+                    if _num(prefix.get(k)))
+    return rows
+
+
 @adapter("SCENARIO")
 def _ingest_scenario(doc, prev) -> List[Row]:
     rows: List[Row] = []

@@ -156,7 +156,28 @@ def _attn_cached(q, k_cache, v_cache, valid_mask, scale,
     kv_dequant_scales`).  The int8→f32 operand embed is exact like the
     bf16 one, so no dequantized (B, M, H, D) cache ever materializes —
     the read stays at 1 byte/element, which is the entire point (the
-    ~2x decode-ceiling lift of the kv8 bench config)."""
+    ~2x decode-ceiling lift of the kv8 bench config).
+
+    **V-side convert status (the PR-6 candidate, resolved):** the K
+    side's ``preferred_element_type`` removed its cache convert, but
+    the V-side contraction here is f32 probabilities x bf16/int8
+    cache, and under jax 0.4.37 EVERY expressible form of that dot
+    still lowers with a materialized ``(B, M, H, D)`` cache convert:
+    ``einsum`` type-promotes the operands before dispatching to
+    ``dot_general``; a raw mixed-dtype ``lax.dot_general`` ACCEPTS
+    the operands but its StableHLO lowering inserts the same
+    ``convert`` on the narrow operand (verified on the lowered text);
+    and the ``DotAlgorithm``/``precision`` API that would express
+    "bf16 operand, f32 accumulation" to XLA directly raises
+    (``ValueError: precision ... not supported``) in this pin.  So
+    the convert
+    is STRUCTURALLY unavoidable at this jax version — documented
+    here rather than half-fixed.  The direct ``dot_general`` form
+    (contract k, batch (b, h) — then transpose to ``bqhd``) is
+    bitwise-equal to this einsum and ready to ride a future jax
+    whose lowering honors mixed-operand dots;
+    ``tests/l0/test_serve_prefix.py::test_v_side_convert_pin`` pins
+    both facts and will flag the upgrade that unblocks it."""
     mask = valid_mask[None, None] if valid_mask.ndim == 2 \
         else valid_mask[:, None]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,

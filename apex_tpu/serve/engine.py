@@ -76,6 +76,17 @@ class ServeConfig:
     max_blocks_per_slot: int = 8
     prefill_chunk: int = 16
     kv_dtype: Optional[Any] = None
+    #: cross-request prefix-cache KV sharing (scheduler module
+    #: docstring has the model): admission probes the allocator's
+    #: content index, matched full blocks map in by refcount and their
+    #: prefill chunks are never dispatched; a full-prompt match
+    #: device-copies ONE block (copy-on-write fork) and re-dispatches
+    #: one token.  Sharing is host-side page-table construction plus
+    #: that one extra executable — the compiled decode/prefill steps
+    #: are untouched and every stream stays bitwise-equal to solo
+    #: ``generate()``.  Default ON; the disaggregated prefill worker
+    #: runs with it off (single transient slot — nothing to share).
+    prefix_cache: bool = True
     #: directory of the content-addressed AOT executable cache
     #: (:mod:`apex_tpu.analysis.export`).  When set — explicitly, or
     #: fleet-wide via the ``APEX_TPU_AOT_CACHE`` env var when this
@@ -309,12 +320,20 @@ class ServeEngine:
         #: created lazily on the first profiled step so an
         #: unprofiled engine's metric catalog is unchanged
         self._m_profiled_s = None
+        self._m_cow = None
+        if serve_cfg.prefix_cache:
+            self._m_cow = self.metrics.counter(
+                "serve_prefix_cow_copies_total",
+                "copy-on-write forks of a shared full-prompt-match "
+                "block (one device copy + one re-dispatched token "
+                "each)")
         self.sched = SlotScheduler(
             num_slots=serve_cfg.num_slots,
             num_blocks=serve_cfg.num_blocks,
             block_size=serve_cfg.block_size,
             max_blocks_per_slot=serve_cfg.max_blocks_per_slot,
-            registry=self.metrics)
+            registry=self.metrics,
+            prefix_cache=serve_cfg.prefix_cache)
         self.stacked = _stack_layer_params(params, cfg.num_layers)
         self.top = {k: v for k, v in params.items()
                     if not k.startswith("block_") and k != "layers"}
@@ -369,6 +388,14 @@ class ServeEngine:
         self._prefill_chunk = jax.jit(self._prefill_body,
                                       donate_argnums=(2, 3, 4, 5))
         self._sample_one = jax.jit(self._sample1_body)
+        #: copy-on-write block fork: its own trace counter, NOT a
+        #: ``trace_counts`` key — the dict pins the three always-hot
+        #: programs' exact shape contract and tests compare it whole;
+        #: the fork is admission-path-only and dispatches at most once
+        #: per full-prompt hit (src/dst block ids are traced scalars,
+        #: so every fork reuses the one executable)
+        self.cow_trace_count = 0
+        self._cow_copy = jax.jit(self._cow_body, donate_argnums=(0,))
         self._outputs: Dict[str, np.ndarray] = {}
         #: cold-start provenance when ``serve_cfg.aot_cache`` is set:
         #: ``{"source": "cache"|"compile", "key": ..., "load_s"|
@@ -507,6 +534,19 @@ class ServeEngine:
             self.scfg.max_blocks_per_slot, top, stacked, kc, vc, ks,
             vs, table_row, chunk_ids, start, n_valid)
 
+    def _cow_body(self, carry, src, dst):
+        """Copy block ``src``'s rows into block ``dst`` across every
+        pool in the donated carry (KV pools, and the int8 format's
+        scale pools with them — a forked block carries its scales, so
+        the dequantized read is bitwise-identical to the source's)."""
+        self.cow_trace_count += 1
+        out = dict(carry)
+        for name in ("kc", "vc", "ks", "vs"):
+            pool = carry.get(name)
+            if pool is not None:
+                out[name] = pool.at[:, dst].set(pool[:, src])
+        return out
+
     # -- host loop -----------------------------------------------------
 
     def submit(self, req: Request) -> None:
@@ -519,24 +559,56 @@ class ServeEngine:
         c = self.scfg.prefill_chunk
         prompt = np.asarray(req.prompt, np.int32)
         n = len(prompt)
-        padded = np.zeros((-(-n // c)) * c, np.int32)
-        padded[:n] = prompt
+        # prefix-cache skip: tokens covered by shared blocks never
+        # dispatch a prefill chunk — chunking starts at the first
+        # unmatched token.  A full-prompt match first forks its last
+        # block copy-on-write (one device copy), then re-dispatches
+        # exactly ONE token (position n-1: the first-token logits need
+        # the last prompt token's forward pass, and that rewrite —
+        # bitwise-identical KV, content is a function of the token
+        # history — must land in the private fork, never the shared
+        # source).  resume == 0 is the sharing-off path, verbatim.
+        s = self.sched.slots[slot]
+        resume = 0
+        if s.cow_src is not None:
+            bs = self.scfg.block_size
+            src = s.cow_src
+            dst = int(self.sched.page_table[slot, (n - 1) // bs])
+            self.carry = self._cow_copy(self.carry, jnp.int32(src),
+                                        jnp.int32(dst))
+            self.sched.finish_cow(slot)
+            self._m_cow.inc()
+            self._admission_dispatches += 1
+            resume = n - 1
+            if self.tracer is not None:
+                self.tracer.record("cow_fork", req.uid,
+                                   self.trace_name, src_block=src,
+                                   dst_block=dst)
+        elif s.prefix_len:
+            resume = s.prefix_len
+        if resume and self.tracer is not None:
+            self.tracer.record("prefix_hit", req.uid, self.trace_name,
+                               matched_tokens=s.prefix_len,
+                               prompt_len=n)
+        m = n - resume
+        padded = np.zeros((-(-m // c)) * c, np.int32)
+        padded[:m] = prompt[resume:]
         table_row = jnp.asarray(self.sched.page_table[slot])
         kc, vc = self.carry["kc"], self.carry["vc"]
         ks, vs = self.carry.get("ks"), self.carry.get("vs")
         logits = None
         kv_err = None
         for j in range(0, len(padded), c):
-            n_valid = min(c, n - j)
+            n_valid = min(c, m - j)
             kc, vc, ks, vs, logits, kv_err = self._prefill_chunk(
                 self.top, self.stacked, kc, vc, ks, vs, table_row,
                 jnp.asarray(padded[None, j:j + c]),
-                jnp.int32(j), jnp.int32(n_valid))
+                jnp.int32(resume + j), jnp.int32(n_valid))
             self._m_prefill.inc()
             self._admission_dispatches += 1
             if self.tracer is not None:
                 self.tracer.record("prefill_chunk", req.uid,
-                                   self.trace_name, start=j,
+                                   self.trace_name, start=resume + j,
                                    n_valid=n_valid)
         if self._m_kv_err is not None and kv_err is not None:
             # admission-time KV quantization-error gauge: a DEFERRED

@@ -29,11 +29,39 @@ list with ownership tracking, exercised BETWEEN decode steps by the
 scheduler, so the compiled step never sees it.  Eviction is a
 scheduler policy built on ``free()`` (preempt-and-recompute, see
 :mod:`apex_tpu.serve.scheduler`).
+
+**Cross-request prefix sharing** (vLLM-class prefix caching) extends
+the allocator with refcounted, content-addressed blocks:
+
+- a FULL aligned block can be **registered** under a chain hash of its
+  token ids (:func:`prefix_block_hashes` — each block's hash chains
+  over every preceding block's, so equal token runs at different
+  positions never alias).  KV content at position ``p`` is a
+  deterministic function of the whole token history ``0..p`` (layer
+  ``l > 0`` activations attend over everything before them), so chain-
+  hash-equal blocks hold bitwise-identical KV — sharing them is exact,
+  int8 scale pools included (quantization is deterministic too);
+- a registered block is **immutable**: the prefix index maps its chain
+  hash to its physical id, and a write would silently poison every
+  current and future reader.  ``assert_writable`` refuses writes into
+  registered or multiply-referenced blocks — a writer must
+  **copy-on-write fork** instead (allocate a private block, device-copy
+  the pool contents, swap its page-table entry, decref the shared one);
+- ``free()`` DECREFS: a block returns to the free list only when its
+  last holder releases it, and a registered block at refcount 0 parks
+  in an LRU **cached** list instead — still matchable by the index, so
+  a hot system prompt stays resident across the whole stream.
+  ``alloc()`` reclaims LRU cached blocks (unregistering them) before
+  raising :class:`PoolExhausted`, which keeps the scheduler's
+  preempt-youngest eviction the LAST resort, after every
+  refcount-0 cached block is gone.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -48,16 +76,54 @@ class PoolExhausted(RuntimeError):
     serve the request; the scheduler catches it to drive eviction."""
 
 
+def chain_seed(block_size: int) -> bytes:
+    """Root of every prefix hash chain: a domain tag binding the block
+    size, so the same tokens under a different block geometry never
+    alias."""
+    return hashlib.sha256(b"apex-tpu-prefix:%d" % block_size).digest()
+
+
+def chain_step(h: bytes, tokens: Sequence[int]) -> bytes:
+    """Extend chain hash ``h`` by one FULL block of token ids."""
+    return hashlib.sha256(
+        h + b"".join(int(t).to_bytes(8, "little", signed=True)
+                     for t in tokens)).digest()
+
+
+def prefix_block_hashes(tokens: Sequence[int],
+                        block_size: int) -> List[bytes]:
+    """Chain hashes of every FULL aligned block of ``tokens``: entry
+    ``i`` is ``sha256(hash[i-1] || tokens[i*bs:(i+1)*bs])`` seeded
+    with a domain tag and the block size, so a block's identity covers
+    its ENTIRE token history — equal token runs at different positions
+    (or under different block sizes) never alias.  Only full blocks
+    hash: the partial tail is always private to its slot."""
+    out: List[bytes] = []
+    h = chain_seed(block_size)
+    for i in range(len(tokens) // block_size):
+        h = chain_step(h, tokens[i * block_size:(i + 1) * block_size])
+        out.append(h)
+    return out
+
+
 class BlockAllocator:
-    """Host-side free-list allocator over the physical block pool.
+    """Host-side refcounted free-list allocator over the physical
+    block pool, with an optional content-addressed prefix index (see
+    the module docstring for the sharing model).
 
     Invariants (enforced, tested):
 
-    - block 0 (:data:`TRASH_BLOCK`) is never allocated;
-    - a block has at most one owner; ``alloc`` never hands out a live
-      block, ``free`` rejects blocks the owner doesn't hold
-      (double-free and cross-owner frees raise ``ValueError``);
-    - ``free_count + live_count == num_blocks - 1`` at all times.
+    - block 0 (:data:`TRASH_BLOCK`) is never allocated, shared, or
+      registered;
+    - ``alloc`` never hands out a live block; ``free`` decrefs and
+      rejects blocks the caller doesn't hold (double-free and
+      cross-owner frees raise ``ValueError``, atomically);
+    - a registered block is immutable (``assert_writable`` refuses it)
+      and parks in the LRU cached list at refcount 0 instead of the
+      free list; ``alloc`` reclaims cached blocks LRU-first before
+      raising :class:`PoolExhausted`;
+    - ``free_count + live_count + cached_count == num_blocks - 1`` at
+      all times.
     """
 
     def __init__(self, num_blocks: int):
@@ -68,7 +134,18 @@ class BlockAllocator:
         self.num_blocks = num_blocks
         # pop() hands out low ids first — deterministic layouts in tests
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
-        self._owner: Dict[int, object] = {}
+        #: block -> holder list; refcount == len (one entry per slot
+        #: mapping the block; the same holder may not hold twice)
+        self._refs: Dict[int, List[object]] = {}
+        #: content addressing: registered block -> chain hash, and the
+        #: prefix index chain hash -> block (live or cached)
+        self._hash: Dict[int, bytes] = {}
+        self._index: Dict[bytes, int] = {}
+        #: refcount-0 registered blocks, least-recently-freed first —
+        #: the LRU eviction order alloc() reclaims in
+        self._cached: "OrderedDict[int, None]" = OrderedDict()
+        #: lifetime telemetry the prefix artifacts/gauges read
+        self.cached_evictions = 0
 
     @property
     def free_count(self) -> int:
@@ -76,39 +153,147 @@ class BlockAllocator:
 
     @property
     def live_count(self) -> int:
-        return len(self._owner)
+        return len(self._refs)
+
+    @property
+    def cached_count(self) -> int:
+        return len(self._cached)
+
+    @property
+    def reclaimable_count(self) -> int:
+        """Blocks an ``alloc`` can hand out right now: the free list
+        plus every refcount-0 cached block (reclaimed LRU-first)."""
+        return len(self._free) + len(self._cached)
+
+    @property
+    def shared_count(self) -> int:
+        """Blocks currently mapped by MORE than one holder — the
+        ``serve_prefix_shared_blocks`` gauge's raw value."""
+        return sum(1 for hs in self._refs.values() if len(hs) > 1)
+
+    def refcount(self, block: int) -> int:
+        return len(self._refs.get(block, ()))
+
+    def is_registered(self, block: int) -> bool:
+        return block in self._hash
 
     def alloc(self, n: int, owner: object) -> List[int]:
-        """``n`` physical block ids now owned by ``owner``; raises
-        :class:`PoolExhausted` (allocating nothing) when fewer than
-        ``n`` are free."""
+        """``n`` private (refcount-1, unregistered) block ids now held
+        by ``owner``; reclaims LRU cached blocks once the free list
+        runs dry, and raises :class:`PoolExhausted` (allocating — and
+        reclaiming — nothing) when ``n`` exceeds even that."""
         if n < 0:
             raise ValueError(f"alloc({n})")
-        if n > len(self._free):
+        if n > self.reclaimable_count:
             raise PoolExhausted(
-                f"need {n} blocks, {len(self._free)} free "
+                f"need {n} blocks, {len(self._free)} free + "
+                f"{len(self._cached)} cached "
                 f"(pool {self.num_blocks}, 1 reserved)")
-        blocks = [self._free.pop() for _ in range(n)]
+        blocks: List[int] = []
+        for _ in range(n):
+            if self._free:
+                blocks.append(self._free.pop())
+            else:
+                # LRU-over-refcount==0: the least-recently-freed
+                # cached block loses its registration and is reused —
+                # BEFORE the scheduler ever preempts a live request
+                victim, _ = self._cached.popitem(last=False)
+                del self._index[self._hash.pop(victim)]
+                self.cached_evictions += 1
+                blocks.append(victim)
         for b in blocks:
-            self._owner[b] = owner
+            self._refs[b] = [owner]
         return blocks
 
     def free(self, blocks: Sequence[int], owner: object) -> None:
-        """Return ``blocks`` to the pool; every block must currently be
-        owned by ``owner`` (the whole call is rejected atomically
-        otherwise — a bad free must not half-release a sequence)."""
+        """Decref ``blocks``; every block must currently be held by
+        ``owner`` (the whole call is rejected atomically otherwise — a
+        bad free must not half-release a sequence).  A block whose
+        LAST reference drops returns to the free list, or — when
+        registered — parks in the LRU cached list, still matchable."""
         for b in blocks:
-            if self._owner.get(b) is not owner:
+            if not any(h is owner for h in self._refs.get(b, ())):
                 raise ValueError(
                     f"block {b} not owned by {owner!r} "
-                    f"(owner={self._owner.get(b)!r}) — double free or "
+                    f"(holders={self._refs.get(b)!r}) — double free or "
                     f"cross-owner free")
         for b in blocks:
-            del self._owner[b]
-            self._free.append(b)
+            hs = self._refs[b]
+            for i, h in enumerate(hs):
+                if h is owner:
+                    hs.pop(i)
+                    break
+            if not hs:
+                del self._refs[b]
+                if b in self._hash:
+                    self._cached[b] = None      # most-recently-freed last
+                else:
+                    self._free.append(b)
+
+    def share(self, block: int, owner: object) -> None:
+        """Incref a REGISTERED block for ``owner`` (a prefix-index
+        hit mapping it into another slot's page table); revives a
+        cached (refcount-0) block back to live."""
+        if block not in self._hash:
+            raise ValueError(
+                f"block {block} is not registered — only "
+                f"content-addressed blocks can be shared")
+        if any(h is owner for h in self._refs.get(block, ())):
+            raise ValueError(
+                f"block {block} already held by {owner!r}")
+        self._cached.pop(block, None)
+        self._refs.setdefault(block, []).append(owner)
+
+    def register(self, block: int, chain_hash: bytes) -> bool:
+        """Mark a LIVE block content-addressed under ``chain_hash``
+        (immutable from here on; parks in the cached list at refcount
+        0).  Returns False — leaving the block a plain private one —
+        when the index already maps the hash to ANOTHER block (the
+        first registration stays canonical).  Re-registering the same
+        block under the same hash is a no-op; under a different hash
+        it raises (content addressing would lie)."""
+        if block == TRASH_BLOCK or block not in self._refs:
+            raise ValueError(
+                f"block {block} is not live — register after alloc, "
+                f"before free")
+        have = self._hash.get(block)
+        if have is not None:
+            if have != chain_hash:
+                raise ValueError(
+                    f"block {block} already registered under a "
+                    f"different chain hash")
+            return True
+        if chain_hash in self._index:
+            return False
+        self._hash[block] = chain_hash
+        self._index[chain_hash] = block
+        return True
+
+    def lookup(self, chain_hash: bytes) -> Optional[int]:
+        """The live-or-cached block registered under ``chain_hash``,
+        or None — the prefix index probe (no side effects)."""
+        return self._index.get(chain_hash)
+
+    def assert_writable(self, block: int, owner: object) -> None:
+        """Refuse a write into a block the writer doesn't privately
+        own: registered (content-addressed — immutable) or
+        multiply-referenced blocks need a copy-on-write fork first,
+        and writing someone else's block is always a bug."""
+        if not any(h is owner for h in self._refs.get(block, ())):
+            raise ValueError(
+                f"block {block} not held by {owner!r} — cannot write")
+        if len(self._refs[block]) > 1:
+            raise ValueError(
+                f"block {block} is shared ({len(self._refs[block])} "
+                f"holders) — fork it (copy-on-write) before writing")
+        if block in self._hash:
+            raise ValueError(
+                f"block {block} is registered (content-addressed, "
+                f"immutable) — fork it (copy-on-write) before writing")
 
     def owned_by(self, owner: object) -> List[int]:
-        return sorted(b for b, o in self._owner.items() if o is owner)
+        return sorted(b for b, hs in self._refs.items()
+                      if any(h is owner for h in hs))
 
 
 def make_pools(num_layers: int, num_blocks: int, block_size: int,

@@ -158,10 +158,16 @@ class PrefillWorker:
         # the worker never dispatches — probing here would eagerly
         # compile+export an executable nobody loads, making fleet cold
         # start slower, not faster.
+        # prefix_cache is OFF on the worker: its pool holds exactly
+        # one transient slot (freed after every gather), and the
+        # router already short-circuits prefix-hit requests STRAIGHT
+        # to a decode replica before they ever reach this worker —
+        # sharing belongs to the replicas, whose installs register
+        # shipped blocks in the DESTINATION index via arm().
         self.scfg = dataclasses.replace(
             serve_cfg, num_slots=1,
             num_blocks=serve_cfg.max_blocks_per_slot + 1,
-            aot_cache=False)
+            aot_cache=False, prefix_cache=False)
         self.mesh = mesh
         self.placement = placement(mesh)
         self.eng = ServeEngine(params, cfg, self.scfg,
@@ -243,11 +249,15 @@ class DecodeReplica:
 
     def can_admit(self, req: Request) -> bool:
         """A free slot and the whole footprint coverable, without
-        side effects (the router checks BEFORE paying the wire)."""
+        side effects (the router checks BEFORE paying the wire).
+        Reclaimable = free + refcount-0 cached prefix blocks — the
+        allocator reclaims its LRU cache transparently inside
+        ``alloc``, so counting only the free list would wedge a
+        replica whose whole pool had parked in the prefix cache."""
         sched = self.eng.sched
         return bool(self.alive and sched.free_slots()
                     and sched.blocks_needed(req)
-                    <= sched.allocator.free_count)
+                    <= sched.allocator.reclaimable_count)
 
     def admit_shipment(self, shp: KVShipment) -> Optional[int]:
         """Install a prefilled request: allocate its FULL footprint,
@@ -417,6 +427,33 @@ class DisaggRouter:
                 f"replica {i} decode-step p99 (from its own "
                 f"serve_decode_step_seconds histogram)")
             for i in range(len(self.replicas))]
+        # -- prefix sharing (per-replica indexes): mirrors of each
+        # replica's own prefix gauges at the same lag-resolved
+        # boundary as every fleet gauge above, plus the router's
+        # straight-to-decode counter — all host bookkeeping, zero new
+        # syncs on any compiled step
+        self._m_prefix_direct = None
+        self._m_rep_hit: List = []
+        self._m_rep_shared: List = []
+        if serve_cfg.prefix_cache:
+            self._m_prefix_direct = self.metrics.counter(
+                "serve_prefix_direct_admissions_total",
+                "prefix-hit requests admitted STRAIGHT to a decode "
+                "replica — no prefill-slice time, no KV shipment for "
+                "the shared span")
+            self._m_rep_hit = [
+                self.metrics.gauge(
+                    f"serve_replica{i}_prefix_hit_rate",
+                    f"replica {i} prefix-cache hit rate (mirror of "
+                    f"its serve_prefix_hit_rate gauge)")
+                for i in range(len(self.replicas))]
+            self._m_rep_shared = [
+                self.metrics.gauge(
+                    f"serve_replica{i}_prefix_shared_blocks",
+                    f"replica {i} blocks mapped by more than one slot "
+                    f"(mirror of its serve_prefix_shared_blocks "
+                    f"gauge)")
+                for i in range(len(self.replicas))]
         # -- SLO admission (apex_tpu.obs.slo): one evaluator per
         # replica over its OWN registry, judged at the same boundary
         # _record_metrics already owns — resolved host state only,
@@ -495,19 +532,41 @@ class DisaggRouter:
 
     # -- routing -------------------------------------------------------
 
+    def _eligible(self, req: Request) -> List[tuple]:
+        """``(load, replica)`` for every replica that may take ``req``
+        this boundary: alive, a free slot + footprint coverage, block
+        utilization under the admission bar, SLO window clean."""
+        scored = [((self._drifting(r),) + r.load(), r)
+                  for r in self.replicas
+                  if r.can_admit(req) and not self._slo_violating(r)]
+        return [(load, r) for load, r in scored
+                if load[2] < self.rcfg.admit_block_util]
+
     def _pick_replica(self, req: Request) -> Optional[DecodeReplica]:
         """Least-loaded eligible replica, from the obs gauges: alive,
         a free slot + footprint coverage, block utilization under the
         admission bar; ranked by (outstanding work, utilization,
         decode p99)."""
-        scored = [((self._drifting(r),) + r.load(), r)
-                  for r in self.replicas
-                  if r.can_admit(req) and not self._slo_violating(r)]
-        eligible = [(load, r) for load, r in scored
-                    if load[2] < self.rcfg.admit_block_util]
+        eligible = self._eligible(req)
         if not eligible:
             return None
         return min(eligible, key=lambda lr: lr[0])[1]
+
+    def _pick_prefix_replica(self, req: Request):
+        """Straight-to-decode probe: ``(replica, matched_tokens)`` for
+        the eligible replica whose prefix index covers the most
+        leading prompt tokens (load breaks ties), or ``(None, 0)``
+        when no index covers any — per-replica indexes, so the probe
+        asks each replica's OWN scheduler.  Side-effect-free:
+        ``probe_prefix_tokens`` touches no refcounts."""
+        best = None
+        for load, r in self._eligible(req):
+            hit = r.eng.sched.probe_prefix_tokens(req.prompt)
+            if hit > 0 and (best is None or (-hit, load) < best[0]):
+                best = ((-hit, load), r)
+        if best is None:
+            return None, 0
+        return best[1], -best[0][0]
 
     def _drifting(self, rep: DecodeReplica) -> bool:
         """True when the replica's drift sentinel holds a confirmed,
@@ -530,6 +589,23 @@ class DisaggRouter:
         """Route the head-of-queue request; False = held (admission
         control: no eligible replica this boundary)."""
         req = self.queue[0]
+        # prefix hit → STRAIGHT to the decode replica holding the
+        # match: its own admission increfs the shared span and
+        # prefills only the unmatched suffix locally — no prefill
+        # slice, no shipment for bytes the destination already holds.
+        # kill_replica recovery re-enqueues continuations through this
+        # same probe, so a rerouted request re-prefills only what the
+        # surviving replicas' indexes don't cover.
+        hit_rep, hit_tokens = self._pick_prefix_replica(req)
+        if hit_rep is not None:
+            self.queue.pop(0)
+            hit_rep.submit(req)
+            self._m_prefix_direct.inc()
+            if self.tracer is not None:
+                self.tracer.record("prefix_direct", req.uid, "router",
+                                   to_replica=hit_rep.index,
+                                   matched_tokens=hit_tokens)
+            return True
         rep = self._pick_replica(req)
         if rep is None:
             return False
@@ -584,6 +660,11 @@ class DisaggRouter:
                 reg.gauge("serve_block_utilization").value)
             p99 = rep.p99()
             self._m_rep_p99[i].set(0.0 if math.isnan(p99) else p99)
+            if self._m_rep_hit:
+                self._m_rep_hit[i].set(
+                    reg.gauge("serve_prefix_hit_rate").value)
+                self._m_rep_shared[i].set(
+                    reg.gauge("serve_prefix_shared_blocks").value)
             if self.slo_evals is not None and rep.alive:
                 self.slo_evals[i].evaluate()
                 self._m_rep_slo[i].set(

@@ -30,6 +30,23 @@ not clever):
 - **retirement**: a slot retires when its budget is spent or its
   request's ``eos_id`` appears; its blocks free immediately and the
   slot is admissible the same step boundary.
+
+With ``prefix_cache=True`` (the engine's ``ServeConfig.prefix_cache``)
+admission grows a cross-request sharing stage on top of the same
+policy: the prompt's full aligned blocks are chain-hashed
+(:func:`apex_tpu.serve.paged.prefix_block_hashes`) and probed against
+the allocator's prefix index — matched blocks map into the new slot's
+page table by INCREF (no prefill dispatch for the matched span; the
+engine starts chunking at the first unmatched token), a full aligned
+match forks its LAST block copy-on-write (the first-token logits need
+the last prompt token's forward pass, and that rewrite must not land
+in a shared block), and retirement/preemption DECREF instead of free,
+parking refcount-0 registered blocks in the allocator's LRU cache —
+matchable until block pressure reclaims them, which keeps the
+preempt-youngest eviction above the last resort it always was.
+Sharing is exact: chain-hash-equal blocks hold bitwise-identical KV
+(content is a deterministic function of the full token history), so
+every output stays bitwise-equal to its solo ``generate()`` run.
 """
 
 from __future__ import annotations
@@ -41,7 +58,14 @@ from typing import Deque, Dict, List, Optional, Tuple
 import numpy as np
 
 from apex_tpu.obs import metrics as obs_metrics
-from apex_tpu.serve.paged import BlockAllocator, PoolExhausted, TRASH_BLOCK
+from apex_tpu.serve.paged import (
+    TRASH_BLOCK,
+    BlockAllocator,
+    PoolExhausted,
+    chain_seed,
+    chain_step,
+    prefix_block_hashes,
+)
 
 
 @dataclasses.dataclass
@@ -99,6 +123,18 @@ class _Slot:
     blocks: List[int]
     emitted: List[int]
     admit_seq: int
+    #: prefix-cache state: tokens covered by shared (or forked) blocks
+    #: — the engine starts prefill at the first unmatched token
+    prefix_len: int = 0
+    #: copy-on-write source: the registered block whose content the
+    #: engine device-copies into this slot's private block at row
+    #: ``prefix_len // block_size - 1`` before the full-match
+    #: last-token re-dispatch; held (increfed) until ``finish_cow``
+    cow_src: Optional[int] = None
+    #: incremental chain-hash cursor for registration: the hash after
+    #: ``hashed_blocks`` full blocks of this slot's token history
+    chain_hash: bytes = b""
+    hashed_blocks: int = 0
 
 
 class SlotScheduler:
@@ -110,7 +146,8 @@ class SlotScheduler:
 
     def __init__(self, num_slots: int, num_blocks: int, block_size: int,
                  max_blocks_per_slot: int,
-                 registry: Optional[obs_metrics.Registry] = None):
+                 registry: Optional[obs_metrics.Registry] = None,
+                 prefix_cache: bool = False):
         if num_slots < 1:
             raise ValueError(f"num_slots={num_slots}")
         self.num_slots = num_slots
@@ -118,6 +155,18 @@ class SlotScheduler:
         self.max_blocks_per_slot = max_blocks_per_slot
         self.max_context = max_blocks_per_slot * block_size
         self.allocator = BlockAllocator(num_blocks)
+        #: cross-request prefix sharing (see module docstring); the
+        #: probe/hit counters below are host bookkeeping the prefix
+        #: gauges and the PREFIXCACHE artifact re-derive from
+        self.prefix_cache = prefix_cache
+        self.prefix_probes = 0
+        self.prefix_hits = 0
+        self.prefix_hit_tokens = 0
+        #: per-admission spans (uid, prompt_len, matched, dispatched)
+        #: — the contradiction-rejecting artifact re-derives skipped
+        #: tokens from these; bounded so a long-lived engine can't
+        #: grow without bound
+        self.prefix_events: List[dict] = []
         self.queue: Deque[Request] = deque()
         self.slots: List[Optional[_Slot]] = [None] * num_slots
         self._admit_seq = 0
@@ -151,6 +200,17 @@ class SlotScheduler:
         self._m_blocks = reg.gauge(
             "serve_block_utilization",
             "live KV blocks / usable pool (trash block excluded)")
+        self._m_hit_rate = self._m_shared = None
+        if prefix_cache:
+            self._m_hit_rate = reg.gauge(
+                "serve_prefix_hit_rate",
+                "admissions whose prompt matched >=1 full cached "
+                "block / admissions probed (cumulative; host "
+                "bookkeeping at admission time)")
+            self._m_shared = reg.gauge(
+                "serve_prefix_shared_blocks",
+                "physical blocks currently mapped by more than one "
+                "slot (refcount > 1)")
         self._update_gauges()
 
     def _update_gauges(self) -> None:
@@ -158,6 +218,11 @@ class SlotScheduler:
         self._m_occ.set(self.n_active() / self.num_slots)
         usable = max(self.allocator.num_blocks - 1, 1)
         self._m_blocks.set(self.allocator.live_count / usable)
+        if self._m_hit_rate is not None:
+            self._m_hit_rate.set(
+                self.prefix_hits / self.prefix_probes
+                if self.prefix_probes else 0.0)
+            self._m_shared.set(float(self.allocator.shared_count))
 
     # -- queue side ----------------------------------------------------
 
@@ -195,7 +260,7 @@ class SlotScheduler:
         req = self.queue[0]
         need = self.blocks_needed(req)
         try:
-            blocks = self.allocator.alloc(need, req)
+            blocks, prefix_len, cow_src = self._alloc_with_prefix(req)
         except PoolExhausted:
             # a preempted request must not preempt others: without
             # this, a continuation and its evictor ping-pong the pool
@@ -210,26 +275,108 @@ class SlotScheduler:
             return ("evict", victim)
         self.queue.popleft()
         slot = free[0]
-        self._install(slot, req, blocks)
+        self._install(slot, req, blocks, prefix_len=prefix_len,
+                      cow_src=cow_src)
         return ("admit", slot, req)
+
+    def _alloc_with_prefix(self, req: Request):
+        """The admission allocation: probe the prefix index over the
+        prompt's full aligned blocks, INCREF every matched block into
+        the new slot's row, allocate the rest fresh.  Returns
+        ``(row blocks, prefix_len, cow_src)``; atomic — a
+        :class:`PoolExhausted` mid-way rolls the increfs back so a
+        failed admission holds nothing.  A full aligned match pops its
+        LAST block into ``cow_src`` (pinned by an incref until the
+        engine's device copy finishes): the first-token logits need
+        the last prompt token's forward pass, whose KV rewrite must
+        land in a private copy-on-write fork, never a shared block."""
+        need = self.blocks_needed(req)
+        a = self.allocator
+        if not self.prefix_cache:
+            return a.alloc(need, req), 0, None
+        prompt = np.asarray(req.prompt)
+        matched: List[int] = []
+        for h in prefix_block_hashes(prompt, self.block_size):
+            b = a.lookup(h)
+            if b is None:
+                break
+            matched.append(b)
+        n = len(prompt)
+        cow_src = None
+        if matched and len(matched) * self.block_size == n:
+            cow_src = matched.pop()
+        # incref matched FIRST: a matched block parked in the
+        # refcount-0 cache must not be reclaimed by our own fresh
+        # alloc below
+        taken: List[int] = []
+        try:
+            for b in matched:
+                a.share(b, req)
+                taken.append(b)
+            if cow_src is not None:
+                a.share(cow_src, req)
+                taken.append(cow_src)
+            fresh = a.alloc(need - len(matched), req)
+        except PoolExhausted:
+            for b in reversed(taken):
+                a.free([b], req)
+            raise
+        prefix_len = n if cow_src is not None \
+            else len(matched) * self.block_size
+        # full match still re-dispatches ONE token (the CoW rewrite)
+        skipped = n - 1 if cow_src is not None else prefix_len
+        self.prefix_probes += 1
+        if prefix_len > 0:
+            self.prefix_hits += 1
+            self.prefix_hit_tokens += skipped
+        if len(self.prefix_events) < 10_000:
+            self.prefix_events.append(
+                {"uid": req.uid, "prompt_len": n,
+                 "matched": prefix_len, "dispatched": n - skipped})
+        return matched + fresh, prefix_len, cow_src
+
+    def probe_prefix_tokens(self, prompt) -> int:
+        """Side-effect-free prefix probe: how many leading prompt
+        tokens the index covers right now (0 when sharing is off) —
+        the disaggregated router's straight-to-decode routing signal."""
+        if not self.prefix_cache:
+            return 0
+        m = 0
+        for h in prefix_block_hashes(np.asarray(prompt),
+                                     self.block_size):
+            if self.allocator.lookup(h) is None:
+                break
+            m += 1
+        return m * self.block_size
 
     def _eviction_victim(self, need: int) -> Optional[int]:
         """Youngest-admitted active slot whose blocks would make the
-        admission possible; never the only active slot."""
+        admission possible; never the only active slot.  Only the
+        victim's PRIVATE references count as freed — a shared block
+        survives its decref, and the allocator's refcount-0 cache is
+        already reclaimable without anyone's eviction."""
         if self.n_active() < 2:
             return None
         cands = [(s.admit_seq, i) for i, s in enumerate(self.slots)
                  if s is not None]
         _seq, victim = max(cands)
-        freed = len(self.slots[victim].blocks)
-        if self.allocator.free_count + freed < need:
+        s = self.slots[victim]
+        freed = sum(1 for b in s.blocks
+                    if self.allocator.refcount(b) == 1)
+        if s.cow_src is not None \
+                and self.allocator.refcount(s.cow_src) == 1:
+            freed += 1
+        if self.allocator.reclaimable_count + freed < need:
             return None
         return victim
 
     def _install(self, slot: int, req: Request,
-                 blocks: List[int]) -> None:
+                 blocks: List[int], prefix_len: int = 0,
+                 cow_src: Optional[int] = None) -> None:
         self.slots[slot] = _Slot(request=req, blocks=blocks, emitted=[],
-                                 admit_seq=self._admit_seq)
+                                 admit_seq=self._admit_seq,
+                                 prefix_len=prefix_len, cow_src=cow_src,
+                                 chain_hash=chain_seed(self.block_size))
         self._admit_seq += 1
         row = np.full(self.max_blocks_per_slot, TRASH_BLOCK, np.int32)
         row[:len(blocks)] = blocks
@@ -246,11 +393,16 @@ class SlotScheduler:
 
     def arm(self, slot: int, first_token: int, prompt_len: int) -> None:
         """Prefill done: record the first sampled token and enter the
-        slot into the decode batch."""
+        slot into the decode batch.  Under prefix sharing the prompt's
+        full aligned blocks register in the content index here — a
+        shipment install (the disaggregated fleet's admission path)
+        arms through this same method, so installed blocks join the
+        destination replica's index with no extra call."""
         self.slots[slot].emitted.append(int(first_token))
         self.last_tok[slot] = int(first_token)
         self.lengths[slot] = prompt_len
         self.active[slot] = True
+        self._advance_registration(slot)
 
     def record_token(self, slot: int, token: int) -> bool:
         """Append one decoded token; returns True when the slot is
@@ -259,17 +411,70 @@ class SlotScheduler:
         s.emitted.append(int(token))
         self.last_tok[slot] = int(token)
         self.lengths[slot] += 1
+        if self.prefix_cache and self.lengths[slot] % self.block_size == 0:
+            # a decode-filled block just completed: register it so a
+            # multi-turn follow-up (prompt = this conversation's
+            # history) matches generated spans too, not just prompts
+            self._advance_registration(slot)
         done = len(s.emitted) >= s.request.max_new_tokens
         if s.request.eos_id is not None and int(token) == s.request.eos_id:
             done = True
         return done
+
+    def _advance_registration(self, slot: int) -> None:
+        """Register every fully-WRITTEN block of ``slot`` not yet
+        content-addressed: chain-hash the slot's token history block
+        by block (position ``p`` holds ``prompt[p]`` below the prompt
+        length and ``emitted[p - prompt_len]`` above it) and offer
+        each to the allocator's index — a hash already mapped to
+        another block leaves this one private (first registration is
+        canonical), which is exactly what keeps a CoW fork out of the
+        index its source owns."""
+        if not self.prefix_cache:
+            return
+        s = self.slots[slot]
+        bs = self.block_size
+        full = int(self.lengths[slot]) // bs
+        if s.hashed_blocks >= full:
+            return
+        n = len(s.request.prompt)
+        prompt = np.asarray(s.request.prompt)
+        while s.hashed_blocks < full:
+            i = s.hashed_blocks
+            toks = [int(prompt[p]) if p < n else s.emitted[p - n]
+                    for p in range(i * bs, (i + 1) * bs)]
+            s.chain_hash = chain_step(s.chain_hash, toks)
+            self.allocator.register(int(s.blocks[i]), s.chain_hash)
+            s.hashed_blocks += 1
+        self._update_gauges()
+
+    def finish_cow(self, slot: int) -> None:
+        """The engine's device copy of the CoW fork landed: drop the
+        pin on the fork source (it stays registered/cached for the
+        next hit; this slot's private copy at the same row is now the
+        write target)."""
+        s = self.slots[slot]
+        if s.cow_src is not None:
+            self.allocator.free([s.cow_src], s.request)
+            s.cow_src = None
+            self._update_gauges()
+
+    def _release_blocks(self, s: _Slot) -> None:
+        """Decref everything a slot holds — its page-table row AND a
+        still-pinned CoW source (a retire/preempt racing the fork must
+        not leak the pin)."""
+        blocks = list(s.blocks)
+        if s.cow_src is not None:
+            blocks.append(s.cow_src)
+            s.cow_src = None
+        self.allocator.free(blocks, s.request)
 
     def retire(self, slot: int) -> Tuple[str, np.ndarray]:
         """Free the slot and its blocks; returns ``(uid, tokens)`` with
         the request's FULL generated stream (pre-preemption tokens
         included)."""
         s = self.slots[slot]
-        self.allocator.free(s.blocks, s.request)
+        self._release_blocks(s)
         self._clear(slot)
         self._m_retire.inc()
         self._update_gauges()
@@ -311,7 +516,7 @@ class SlotScheduler:
         queue.  Returns the continuation."""
         cont = self.continuation(slot, resume_key)
         s = self.slots[slot]
-        self.allocator.free(s.blocks, s.request)
+        self._release_blocks(s)
         self._clear(slot)
         self.queue.append(cont)
         self._m_preempt.inc()

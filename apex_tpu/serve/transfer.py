@@ -49,6 +49,16 @@ admission path and re-prefills there through the existing
 preempt-and-recompute machinery — bitwise the same tokens, paid in
 decode-slice compute instead of transfer bytes
 (:mod:`apex_tpu.serve.router`).
+
+Prefix sharing composes ON TOP of shipment, not inside it.  A
+prefix-HIT request never reaches this module: the router admits it
+straight to the decode replica holding the match, which prefills only
+the unmatched suffix locally — zero shipped bytes for the shared
+span.  A shipped (miss) request still feeds the sharing machinery at
+its destination: ``admit_shipment`` arms through the scheduler, whose
+``arm()`` registers the installed full blocks in the DESTINATION
+replica's content index, so the next same-prefix request hits there.
+The shipment format and the gather/install programs are untouched.
 """
 
 from __future__ import annotations

@@ -947,6 +947,145 @@ def bench_serve_disagg(warmup: int, iters: int, peak: float,
             "ab_ok": bool(ab_ok)}
 
 
+def bench_serve_prefix(warmup: int, iters: int, peak: float,
+                       num_slots: int = 16, prefill: int = 512,
+                       new_tokens: int = 128, tiny: bool = False):
+    """Cross-request prefix-sharing A/B at EQUAL work: the SAME
+    shared-system-prompt c``num_slots`` mixed-length stream served
+    with the prefix cache ON (``ServeConfig.prefix_cache=True``,
+    content-addressed block sharing + CoW + prefill skip on hit) and
+    OFF (every request prefills its full prompt).
+
+    The gated numbers are DETERMINISTIC token/block counts, not wall
+    time — CPU smoke and a chip round agree on them exactly:
+
+    - ``prefill_tokens_dispatched`` — tokens-to-first-token in work
+      terms: how many prompt tokens each arm actually pushed through
+      the prefill program (the sharing arm skips the matched span);
+    - ``admitted_requests_per_block`` — admitted requests / peak live
+      blocks: the pool deduplication (same stream, same devices,
+      smaller resident footprint with sharing on).
+
+    ``ab_ok`` = sharing dispatched FEWER prefill tokens AND admitted
+    MORE requests per resident block AND both arms stayed at ONE
+    decode trace (sharing must not mint executables).  Wall-clock
+    ``tok_s``/``p50_ms``/``p99_ms`` ride along per arm, read from each
+    engine's own ``serve_decode_step_seconds`` histogram.  The
+    committed ``PREFIXCACHE_r*.json`` artifact (``tools/
+    serve_prefix.py``, schema ``apex_tpu/analysis/prefixcache.py``)
+    records the same sweep plus the per-request spans and the bitwise
+    drill as gate memory."""
+    del peak, warmup, iters
+    import dataclasses
+
+    import numpy as np
+
+    from apex_tpu import amp
+    from apex_tpu.models.gpt import GPTModel, gpt_small_tpu, gpt_tiny
+    from apex_tpu.obs.metrics import Registry
+    from apex_tpu.serve import Request, ServeConfig, ServeEngine
+
+    cfg = gpt_tiny() if tiny else gpt_small_tpu()
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    a = amp.initialize(opt_level="O2", verbosity=0)
+    params = a.model_params_from(params)
+
+    block = 16 if not tiny else 4
+    mb = -(-(prefill + new_tokens) // block)
+    scfg_on = ServeConfig(
+        num_slots=num_slots, block_size=block,
+        num_blocks=num_slots * mb + 1, max_blocks_per_slot=mb,
+        prefill_chunk=min(prefill, 128 if not tiny else 8),
+        prefix_cache=True)
+    scfg_off = dataclasses.replace(scfg_on, prefix_cache=False)
+    rng = np.random.RandomState(11)
+
+    # block-aligned shared system prompt (half the prefill budget) +
+    # mixed-length per-request tails: the chat-service shape the
+    # sharing claim is about
+    sys_len = max((prefill // 2) // block * block, block)
+    system = rng.randint(0, cfg.vocab_size, (sys_len,))
+    tail_budget = max(prefill - sys_len, 1)
+    prompts = []
+    for i in range(num_slots):
+        tlen = max(int(tail_budget * (0.5 + 0.5 * (i % 2))), 1)
+        prompts.append(np.concatenate(
+            [system, rng.randint(0, cfg.vocab_size, (tlen,))]))
+
+    def drive(scfg, tag):
+        eng = ServeEngine(params, cfg, scfg, registry=Registry())
+        hist = eng.metrics.histogram("serve_decode_step_seconds")
+        toks = eng.metrics.counter("serve_tokens_total")
+        chunks = eng.metrics.counter("serve_prefill_chunks_total")
+        for i, p in enumerate(prompts):
+            eng.submit(Request(uid=f"{tag}{i}", prompt=p,
+                               max_new_tokens=new_tokens))
+        eng.step()                    # admission + compile + 1 step
+        mark = hist.state()
+        tok0 = toks.value
+        peak_live = peak_shared = 0
+        t0 = time.perf_counter()
+        while not eng.sched.idle():
+            eng._admit_and_evict()
+            eng.step()
+            peak_live = max(peak_live, eng.sched.allocator.live_count)
+            peak_shared = max(peak_shared,
+                              eng.sched.allocator.shared_count)
+        wall = time.perf_counter() - t0
+        sched = eng.sched
+        if scfg.prefix_cache:
+            # the scheduler's own spans are the ground truth the
+            # artifact re-derives everything from
+            dispatched = sum(e["dispatched"]
+                             for e in sched.prefix_events)
+        else:
+            dispatched = sum(len(p) for p in prompts)
+        arm = {
+            "tok_s": round((toks.value - tok0) / wall, 2)
+            if wall else 0.0,
+            "p50_ms": round(hist.quantile(0.5, since=mark) * 1e3, 3),
+            "p99_ms": round(hist.quantile(0.99, since=mark) * 1e3, 3),
+            "prefill_chunks": int(chunks.value),
+            "prefill_tokens_dispatched": int(dispatched),
+            "admitted_requests": len(prompts),
+            "peak_live_blocks": int(peak_live),
+            "admitted_requests_per_block":
+                round(len(prompts) / max(peak_live, 1), 6),
+            "retraces": eng.trace_counts["decode"],
+        }
+        if scfg.prefix_cache:
+            arm["prefix"] = {
+                "probes": int(sched.prefix_probes),
+                "hits": int(sched.prefix_hits),
+                "hit_rate": round(
+                    sched.prefix_hits / max(sched.prefix_probes, 1), 6),
+                "hit_tokens": int(sched.prefix_hit_tokens),
+                "cow_copies": int(eng.metrics.counter(
+                    "serve_prefix_cow_copies_total").value),
+                "shared_blocks_peak": int(peak_shared),
+                "cached_evictions": int(
+                    sched.allocator.cached_evictions),
+                "requests": [dict(e) for e in sched.prefix_events],
+            }
+        return arm
+
+    sharing = drive(scfg_on, "p")
+    baseline = drive(scfg_off, "b")
+    ab_ok = (sharing["prefill_tokens_dispatched"]
+             < baseline["prefill_tokens_dispatched"]
+             and sharing["admitted_requests_per_block"]
+             > baseline["admitted_requests_per_block"]
+             and sharing["retraces"] == 1 and baseline["retraces"] == 1)
+    return {"tok_s": sharing["tok_s"], "batch": num_slots,
+            "prefill": prefill, "new_tokens": new_tokens,
+            "p50_ms": sharing["p50_ms"], "p99_ms": sharing["p99_ms"],
+            "system_prompt_tokens": int(sys_len), "block_size": block,
+            "sharing": sharing, "baseline": baseline,
+            "ab_ok": bool(ab_ok)}
+
+
 def bench_pipeline_ab(warmup: int, iters: int, peak: float,
                       batch: int = 256, size: int = 64):
     """Host-input pipeline A/B at a COMPUTE-visible shape (b256/64px:
@@ -1811,6 +1950,15 @@ def main(argv=None):
                optional=True, warmup=1, iters=1, n_replicas=2,
                slots_per_replica=8, prefill=512, new_tokens=128,
                tiny=False)
+        # cross-request prefix sharing vs no sharing on the SAME c16
+        # shared-system-prompt stream at equal devices: gated on the
+        # deterministic counts (sharing arm dispatches fewer prefill
+        # tokens + admits more requests per resident block, retraces==1
+        # both arms) via ab_ok; the committed PREFIXCACHE_r*.json
+        # (tools/serve_prefix.py) carries the spans + bitwise drill
+        record("gpt_small_tpu_serve_prefix_c16", bench_serve_prefix,
+               optional=True, warmup=1, iters=1, num_slots=16,
+               prefill=512, new_tokens=128, tiny=False)
         # pipeline-vs-naive at the compute-visible shape; gated on the
         # delta sign (ab_ok), not the wire-coupled absolute rate
         record("resnet50_pipeline_ab_64px", bench_pipeline_ab,

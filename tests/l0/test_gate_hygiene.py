@@ -1188,3 +1188,128 @@ def test_repo_fleetlint_validates():
     assert gate_hygiene._validate_fleetlints(str(REPO)) == []
     assert sorted(REPO.glob("FLEETLINT_r*.json")), \
         "the fleet SPMD gate artifact must be committed"
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 17: PREFIXCACHE_r*.json — cross-request prefix sharing is gate memory
+# ---------------------------------------------------------------------------
+
+def _valid_prefixcache():
+    # spans: one cold miss, two partial hits, one full-prompt CoW match
+    # (dispatched floored at 1 — the CoW rewrite re-runs one token)
+    spans = [
+        {"uid": "q0", "prompt_len": 16, "matched": 0, "dispatched": 16},
+        {"uid": "q1", "prompt_len": 16, "matched": 8, "dispatched": 8},
+        {"uid": "q2", "prompt_len": 16, "matched": 8, "dispatched": 8},
+        {"uid": "q3", "prompt_len": 16, "matched": 16, "dispatched": 1},
+    ]
+    return {
+        "round": 1, "platform": "cpu",
+        "config": {"model": "gpt_tiny", "concurrency": 4,
+                   "system_prompt_tokens": 8, "prefill": 16,
+                   "new_tokens": 4, "block_size": 4},
+        "sharing": {
+            "prefill_chunks": 5, "prefill_tokens_dispatched": 33,
+            "admitted_requests": 4, "peak_live_blocks": 10,
+            "admitted_requests_per_block": 0.4,
+            "p50_ms": 1.9, "p99_ms": 3.2, "retraces": 1,
+            "prefix": {"probes": 4, "hits": 3, "hit_rate": 0.75,
+                       "hit_tokens": 31, "cow_copies": 1,
+                       "shared_blocks_peak": 4, "cached_evictions": 0,
+                       "requests": spans}},
+        "baseline": {
+            "prefill_chunks": 8, "prefill_tokens_dispatched": 64,
+            "admitted_requests": 4, "peak_live_blocks": 16,
+            "admitted_requests_per_block": 0.25,
+            "p50_ms": 1.8, "p99_ms": 3.1, "retraces": 1},
+        "bitwise_ok": True,
+        "gate": {"hit_rate_ok": True, "ab_ok": True,
+                 "bitwise_ok": True, "ok": True},
+    }
+
+
+def test_committed_prefixcache_validated_against_schema(tmp_repo):
+    _analysis_module(tmp_repo, "prefixcache")
+    (tmp_repo / "PREFIXCACHE_r07_bad.json").write_text('{"round": 7}')
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "bad prefixcache")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("PREFIXCACHE_r07_bad.json" in p
+               for p in verdict["invalid_prefixcaches"])
+    assert gate_hygiene.main(["--repo", str(tmp_repo)]) == 1
+
+
+def test_prefixcache_span_contradiction_fails_hygiene(tmp_repo):
+    """A span claiming a full-prompt match re-dispatched NOTHING is the
+    lie the schema exists to reject: dispatched must equal
+    max(prompt_len - matched, 1) — the CoW rewrite always re-runs one
+    token, so 'free' full hits cannot be typed in."""
+    _analysis_module(tmp_repo, "prefixcache")
+    doc = _valid_prefixcache()
+    doc["sharing"]["prefix"]["requests"][3]["dispatched"] = 0
+    (tmp_repo / "PREFIXCACHE_r08_span.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "free full hit")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("CONTRADICTORY" in p and "CoW" in p
+               for p in verdict["invalid_prefixcaches"])
+
+
+def test_prefixcache_hit_tokens_must_derive_from_spans(tmp_repo):
+    """The headline skipped-token total must BE the span sum — an
+    inflated hit_tokens (a faked saving) is rejected by re-derivation."""
+    _analysis_module(tmp_repo, "prefixcache")
+    doc = _valid_prefixcache()
+    doc["sharing"]["prefix"]["hit_tokens"] = 999
+    (tmp_repo / "PREFIXCACHE_r09_fab.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "inflated hit tokens")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert any("CONTRADICTORY" in p and "hit_tokens" in p
+               for p in verdict["invalid_prefixcaches"])
+
+
+def test_prefixcache_ab_verdict_must_derive_from_arms(tmp_repo):
+    """gate.ab_ok over a baseline that dispatched FEWER tokens than the
+    sharing arm is an unearned win; the verdict must re-derive."""
+    _analysis_module(tmp_repo, "prefixcache")
+    doc = _valid_prefixcache()
+    doc["baseline"]["prefill_tokens_dispatched"] = 20   # < sharing's 33
+    (tmp_repo / "PREFIXCACHE_r10_lie.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "unearned ab win")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert any("CONTRADICTORY verdict" in p and "ab_ok" in p
+               for p in verdict["invalid_prefixcaches"])
+
+
+def test_valid_prefixcache_passes_and_untracked_fails(tmp_repo):
+    _analysis_module(tmp_repo, "prefixcache")
+    (tmp_repo / "PREFIXCACHE_r11_ok.json").write_text(
+        json.dumps(_valid_prefixcache()))
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]            # parked-but-untracked
+    assert verdict["untracked"] == ["PREFIXCACHE_r11_ok.json"]
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "good prefixcache")
+    assert gate_hygiene.check(str(tmp_repo))["ok"]
+
+
+def test_repo_prefixcache_validates():
+    """The committed PREFIXCACHE artifact is the schema's reference
+    instance; it must stay valid — and its gate must HOLD (real hit
+    rate, fewer dispatched prefill tokens, denser pool, bitwise parity:
+    the ISSUE-17 acceptance bars ride this assertion)."""
+    assert gate_hygiene._validate_prefixcaches(str(REPO)) == []
+    arts = sorted(REPO.glob("PREFIXCACHE_r*.json"))
+    assert arts, "the prefix-sharing gate artifact must be committed"
+    doc = json.loads(arts[-1].read_text())
+    assert doc["gate"]["ok"] is True
+    assert doc["sharing"]["prefix"]["hit_rate"] > 0.5
+    assert doc["sharing"]["prefill_tokens_dispatched"] \
+        < doc["baseline"]["prefill_tokens_dispatched"]
+    assert doc["sharing"]["admitted_requests_per_block"] \
+        > doc["baseline"]["admitted_requests_per_block"]
+    assert doc["bitwise_ok"] is True

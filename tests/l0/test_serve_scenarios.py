@@ -96,6 +96,33 @@ def test_cell_matrix_covers_contexts_and_axes():
     assert any(k["context"] == 32768 for _, k, _ in full)
 
 
+def test_chat_cell_reuses_history_and_churn_pins_sharing_off(tiny):
+    """The multi-turn chat cell's second turn resubmits each request's
+    own prompt + streamed reply, so the content index must HIT (prompt
+    blocks registered at arm, reply blocks at decode boundaries) and
+    the cell records its prefix counters; a churn cell pins sharing
+    OFF — the repeated training-stream prompts would dedupe and absorb
+    the engineered block shortage — so its record carries NO prefix
+    block."""
+    cfg, params, ids = tiny
+    draft = truncated_draft(params, cfg, 1)
+    knobs = dict(context=32, new_tokens=4, num_slots=2,
+                 arrival="steady", sampling="greedy", kv8=False,
+                 spec=False, spec_k=2)
+    reqs = serve_scenarios._requests(ids, 16, 4, 2, "greedy")
+    chat = serve_scenarios.run_cell(cfg, params, draft, list(reqs),
+                                    churn=False, chat=True, **knobs)
+    assert chat["prefix"]["probes"] >= 4     # both turns probe
+    assert chat["prefix"]["hits"] >= 2       # every turn-2 admission
+    assert chat["prefix"]["hit_rate"] > 0
+    assert chat["gate"]["retrace_ok"], chat
+
+    reqs = serve_scenarios._requests(ids, 16, 4, 2, "greedy")
+    churn = serve_scenarios.run_cell(cfg, params, draft, list(reqs),
+                                     churn=True, chat=False, **knobs)
+    assert "prefix" not in churn
+
+
 def test_committed_artifact_round_trips_the_tool_gate():
     """The committed r01 carries the tool's own derived verdict: the
     gated A/B rows all won (tokens/step strictly greater with spec

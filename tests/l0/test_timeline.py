@@ -54,7 +54,8 @@ def test_every_committed_family_has_an_adapter():
     for expect in ("BENCH", "KERNELBENCH", "MEMLINT", "PRECLINT",
                    "SCENARIO", "SERVE_DISAGG", "TRACE", "OBS",
                    "EXPORT", "CONVERGENCE", "DECODE_PROFILE",
-                   "DECODE_DECOMPOSE", "BENCH_VARIANCE", "FLEETLINT"):
+                   "DECODE_DECOMPOSE", "BENCH_VARIANCE", "FLEETLINT",
+                   "PREFIXCACHE"):
         assert expect in fams, f"{expect} not ingested ({fams})"
     assert all(rec["files"] for rec in out["coverage"].values())
     assert sum(rec["rows"] for rec in out["coverage"].values()) > 100
@@ -80,6 +81,31 @@ def test_fleetlint_adapter_rows():
     assert ("ddp_o1_train", "consistent", 1.0) in rows
     assert ("ddp_o1_train", "n_collectives", 4.0) in rows
     assert ("gate", "inconsistent_lanes", 0.0) in rows
+
+
+def test_prefixcache_adapter_rows():
+    """PREFIXCACHE rounds chart both arms' deterministic counts plus
+    the hit-rate headline — a round where sharing quietly dispatches
+    MORE prefill tokens (or the hit rate collapses) shows up as a
+    timeline regression, not a silent rot."""
+    doc = {"round": 1, "platform": "cpu",
+           "sharing": {"prefill_chunks": 5,
+                       "prefill_tokens_dispatched": 33,
+                       "peak_live_blocks": 10,
+                       "admitted_requests_per_block": 0.4,
+                       "p50_ms": 1.9, "p99_ms": 3.2, "retraces": 1,
+                       "prefix": {"hit_rate": 0.75, "hit_tokens": 31,
+                                  "cow_copies": 1,
+                                  "shared_blocks_peak": 4}},
+           "baseline": {"prefill_tokens_dispatched": 64,
+                        "peak_live_blocks": 16,
+                        "admitted_requests_per_block": 0.25}}
+    rows = timeline.ADAPTERS["PREFIXCACHE"](doc, {})
+    assert ("sharing", "prefill_tokens_dispatched", 33.0) in rows
+    assert ("baseline", "prefill_tokens_dispatched", 64.0) in rows
+    assert ("sharing", "admitted_requests_per_block", 0.4) in rows
+    assert ("prefix", "hit_rate", 0.75) in rows
+    assert ("prefix", "hit_tokens", 31.0) in rows
 
 
 def test_unknown_family_is_a_lint_error(tmp_path):

@@ -104,6 +104,14 @@ This check fails (exit 1) when
   lanes — a contradictory fleet verdict is schema-invalid) — "every
   rank compiles the same collective schedule" is gate memory, not
   prose, or
+- a committed ``PREFIXCACHE_r*.json`` does not validate against the
+  prefix-sharing schema (``apex_tpu/analysis/prefixcache.py``: the
+  headline hit/skip counters must RE-DERIVE from the recorded
+  per-request spans, and the ``gate`` verdict from the recorded
+  arms — a hit rate the spans refute, a skipped-token total they
+  don't add up to, or a typed-in "ok" is CONTRADICTORY and
+  schema-invalid) — the KV-dedup A/B and its bitwise drill are gate
+  memory like every other floor, or
 - a committed ``TIMELINE_r*.json`` does not validate against the
   timeline schema (``apex_tpu/analysis/timeline.py``: every
   regression row must cite a series whose recorded points actually
@@ -149,7 +157,8 @@ PATTERNS = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json",
             "CONVERGENCE_r*.json", "EXPORT_r*.json",
             "SERVE_DISAGG_r*.json", "SCENARIO_r*.json",
             "TRACE_r*.json", "TIMELINE_r*.json",
-            "PROFILE_DRIFT_r*.json", "FLEETLINT_r*.json")
+            "PROFILE_DRIFT_r*.json", "FLEETLINT_r*.json",
+            "PREFIXCACHE_r*.json")
 
 #: Round-numbered incident artifacts additionally get schema-validated.
 INCIDENT_PATTERN = "INCIDENT_r*.json"
@@ -194,8 +203,11 @@ TIMELINE_PATTERN = "TIMELINE_r*.json"
 #: ... and the continuous-profile drift artifacts ...
 PROFILE_DRIFT_PATTERN = "PROFILE_DRIFT_r*.json"
 
-#: ... and the cross-rank SPMD consistency artifacts.
+#: ... and the cross-rank SPMD consistency artifacts ...
 FLEETLINT_PATTERN = "FLEETLINT_r*.json"
+
+#: ... and the cross-request prefix-sharing gate artifacts.
+PREFIXCACHE_PATTERN = "PREFIXCACHE_r*.json"
 
 
 def _load_by_path(repo: str, *rel: str):
@@ -436,6 +448,22 @@ def _validate_fleetlints(repo: str) -> "list[str]":
     return problems
 
 
+def _validate_prefixcaches(repo: str) -> "list[str]":
+    """Schema problems over every present PREFIXCACHE_r*.json, as
+    ``path: problem`` strings (``apex_tpu/analysis/prefixcache.py`` —
+    which also re-derives the hit/skip counters from the recorded
+    per-request spans)."""
+    schema = _load_by_path(repo, "apex_tpu", "analysis",
+                           "prefixcache.py")
+    if schema is None:
+        return []
+    problems = []
+    for p in sorted(Path(repo).glob(PREFIXCACHE_PATTERN)):
+        for msg in schema.validate_prefixcache_file(str(p)):
+            problems.append(f"{p.name}: {msg}")
+    return problems
+
+
 def _git(repo: str, *args: str) -> "str | None":
     """stdout of a git command, or None when git/The repo is unavailable
     (the best-effort contract)."""
@@ -466,7 +494,8 @@ def check(repo: str = str(REPO)) -> dict:
                 "invalid_exports": [], "invalid_serve_disaggs": [],
                 "invalid_scenarios": [], "invalid_traces": [],
                 "invalid_variances": [], "invalid_timelines": [],
-                "invalid_profile_drifts": [], "invalid_fleetlints": []}
+                "invalid_profile_drifts": [], "invalid_fleetlints": [],
+                "invalid_prefixcaches": []}
     tracked = set(tracked_raw.split())
     missing = [f for f in REQUIRED
                if not (Path(repo) / f).exists() or f not in tracked]
@@ -501,13 +530,14 @@ def check(repo: str = str(REPO)) -> dict:
     invalid_tl = _validate_timelines(repo)
     invalid_pd = _validate_profile_drifts(repo)
     invalid_fl = _validate_fleetlints(repo)
+    invalid_pc = _validate_prefixcaches(repo)
     return {"ok": not (missing or untracked or dirty or invalid
                        or invalid_mem or invalid_prec or invalid_dec
                        or invalid_obs or invalid_prof or invalid_conv
                        or invalid_exp or invalid_disagg
                        or invalid_scen or invalid_trace
                        or invalid_var or invalid_tl
-                       or invalid_pd or invalid_fl),
+                       or invalid_pd or invalid_fl or invalid_pc),
             "missing": missing, "untracked": untracked, "dirty": dirty,
             "invalid_incidents": invalid,
             "invalid_memlints": invalid_mem,
@@ -523,7 +553,8 @@ def check(repo: str = str(REPO)) -> dict:
             "invalid_variances": invalid_var,
             "invalid_timelines": invalid_tl,
             "invalid_profile_drifts": invalid_pd,
-            "invalid_fleetlints": invalid_fl}
+            "invalid_fleetlints": invalid_fl,
+            "invalid_prefixcaches": invalid_pc}
 
 
 def main(argv=None) -> int:
@@ -558,7 +589,9 @@ def main(argv=None) -> int:
               f"profile-drift records "
               f"{verdict.get('invalid_profile_drifts', [])}; invalid "
               f"fleetlint records "
-              f"{verdict.get('invalid_fleetlints', [])}",
+              f"{verdict.get('invalid_fleetlints', [])}; invalid "
+              f"prefix-cache records "
+              f"{verdict.get('invalid_prefixcaches', [])}",
               file=sys.stderr)
         return 1
     return 0

@@ -1,0 +1,185 @@
+"""Prefix-sharing gate artifact: the shared-system-prompt c16 A/B plus
+the bitwise drill, committed as ``PREFIXCACHE_r*.json``.
+
+Runs ``bench.bench_serve_prefix`` — the SAME sweep the
+``gpt_small_tpu_serve_prefix_c16`` bench config runs on chip — then
+drills fidelity: the sharing engine (CoW fork included — one request
+resubmits the previous full prompt) must stream every output BITWISE
+equal to solo ``generate()``.  Sharing is a perf optimization, never a
+fidelity trade, and the artifact carries the proof.
+
+The emitted document (schema ``apex_tpu/analysis/prefixcache.py``,
+validated by ``tools/gate_hygiene.py`` in tier-1) carries the gates in
+machine-checked form:
+
+- ``gate.hit_rate_ok`` — the content index actually matched
+  (``hit_rate > 0``, re-derived from the per-request spans);
+- ``gate.ab_ok`` — the sharing arm dispatched FEWER prefill tokens
+  and admitted MORE requests per resident block than the sharing-off
+  arm on the identical stream, at one decode trace each;
+- ``gate.bitwise_ok`` — the drill's outputs greedy-match solo.
+
+A verdict contradicting the recorded spans is schema-invalid, so the
+artifact cannot rot into an "ok" nobody re-derived.
+
+Usage:
+    python tools/serve_prefix.py --emit-json PREFIXCACHE_r01.json \
+        [--cpu-smoke] [--slots 16] [--prefill 512] [--new-tokens 128]
+
+``--cpu-smoke`` is the committed-r01 shape: gpt_tiny at full c16
+concurrency — the sharing topology is the real thing, the model is
+test-scale.  Without it the sweep runs gpt_small_tpu (a chip-round
+config).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+os.environ.setdefault("APEX_TPU_KERNELS", "jnp")
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+jax.config.update("jax_platforms",
+                  os.environ.get("APEX_TPU_TEST_PLATFORM", "cpu"))
+jax.config.update("jax_threefry_partitionable", True)
+
+
+def bitwise_drill(tiny: bool, prefill: int, new_tokens: int) -> dict:
+    """Serve a shared-prefix burst — partial hits AND a full-prompt
+    CoW fork — through the sharing engine and check every streamed
+    output bitwise against solo ``generate()``.  Returns the drill
+    record for the artifact's ``bitwise_ok`` evidence trail."""
+    from apex_tpu import amp
+    from apex_tpu.models.generate import generate
+    from apex_tpu.models.gpt import GPTModel, gpt_small_tpu, gpt_tiny
+    from apex_tpu.obs.metrics import Registry
+    from apex_tpu.serve import Request, ServeConfig, ServeEngine
+
+    cfg = gpt_tiny() if tiny else gpt_small_tpu()
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    params = amp.initialize(
+        opt_level="O2", verbosity=0).model_params_from(params)
+    block = 4 if tiny else 16
+    mb = -(-(prefill + new_tokens) // block)
+    scfg = ServeConfig(num_slots=4, block_size=block,
+                       num_blocks=4 * mb + 1, max_blocks_per_slot=mb,
+                       prefill_chunk=min(prefill, 8 if tiny else 128),
+                       prefix_cache=True)
+    eng = ServeEngine(params, cfg, scfg, registry=Registry())
+    rng = np.random.RandomState(7)
+    sys_len = max((prefill // 2) // block * block, block)
+    system = rng.randint(0, cfg.vocab_size, (sys_len,))
+    prompts = [np.concatenate(
+        [system, rng.randint(0, cfg.vocab_size,
+                             (max(prefill - sys_len, 1) // (i + 1),))])
+        for i in range(3)]
+    prompts.append(prompts[0].copy())     # full-prompt match: CoW fork
+    for i, p in enumerate(prompts):
+        eng.submit(Request(uid=f"d{i}", prompt=p,
+                           max_new_tokens=new_tokens))
+    out = eng.run()
+    bitwise = True
+    for i, p in enumerate(prompts):
+        want = np.asarray(generate(
+            params, cfg, jnp.asarray(p[None]),
+            new_tokens))[0, len(p):]
+        if not np.array_equal(out[f"d{i}"], want):
+            bitwise = False
+    return {"requests": len(prompts),
+            "cow_copies": int(eng.metrics.counter(
+                "serve_prefix_cow_copies_total").value),
+            "hits": int(eng.sched.prefix_hits),
+            "bitwise_ok": bool(bitwise)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--emit-json", default=None,
+                    metavar="PREFIXCACHE_rN.json",
+                    help="write the committed gate artifact")
+    ap.add_argument("--cpu-smoke", action="store_true",
+                    help="gpt_tiny model at full c16 concurrency (the "
+                         "committed-r01 shape); default gpt_small_tpu")
+    ap.add_argument("--slots", type=int, default=16,
+                    help="concurrent requests (= engine slots)")
+    ap.add_argument("--prefill", type=int, default=None,
+                    help="prompt-length budget (default 512; 64 under "
+                         "--cpu-smoke)")
+    ap.add_argument("--new-tokens", type=int, default=None,
+                    help="generation budget (default 128; 16 under "
+                         "--cpu-smoke)")
+    opts = ap.parse_args(argv)
+    prefill = opts.prefill if opts.prefill is not None \
+        else (64 if opts.cpu_smoke else 512)
+    new_tokens = opts.new_tokens if opts.new_tokens is not None \
+        else (16 if opts.cpu_smoke else 128)
+
+    import bench
+
+    rec = bench.bench_serve_prefix(
+        warmup=1, iters=1, peak=0.0, num_slots=opts.slots,
+        prefill=prefill, new_tokens=new_tokens, tiny=opts.cpu_smoke)
+    drill = bitwise_drill(opts.cpu_smoke, prefill, new_tokens)
+    sharing = dict(rec["sharing"])
+    doc = {
+        "round": 0,
+        "platform": jax.devices()[0].platform,
+        "config": {
+            "model": "gpt_tiny" if opts.cpu_smoke else "gpt_small_tpu",
+            "concurrency": int(rec["batch"]),
+            "system_prompt_tokens": int(rec["system_prompt_tokens"]),
+            "prefill": int(prefill),
+            "new_tokens": int(new_tokens),
+            "block_size": int(rec["block_size"]),
+        },
+        "sharing": sharing,
+        "baseline": rec["baseline"],
+        "drill": drill,
+        "bitwise_ok": drill["bitwise_ok"],
+        "gate": {
+            "hit_rate_ok": sharing["prefix"]["hit_rate"] > 0.0,
+            "ab_ok": bool(rec["ab_ok"]),
+            "bitwise_ok": drill["bitwise_ok"],
+            "ok": bool(sharing["prefix"]["hit_rate"] > 0.0
+                       and rec["ab_ok"] and drill["bitwise_ok"]),
+        },
+        "note": (
+            "CPU smoke: the gated numbers are deterministic "
+            "token/block counts (prefill tokens dispatched, admitted "
+            "requests per resident block), identical on chip — the "
+            "wall-clock percentiles ride along as context only."
+            if jax.devices()[0].platform == "cpu" else
+            "on-chip shared-system-prompt A/B at equal device count"),
+    }
+    if opts.emit_json:
+        m = re.search(r"_r(\d+)\.json$",
+                      os.path.basename(opts.emit_json))
+        doc["round"] = int(m.group(1)) if m else 0
+        from apex_tpu.analysis.prefixcache import validate_prefixcache
+        problems = validate_prefixcache(doc)
+        if problems:
+            print(f"serve_prefix: REFUSING schema-invalid artifact: "
+                  f"{problems}", file=sys.stderr)
+            return 1
+        with open(opts.emit_json, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"prefix-cache artifact written: {opts.emit_json}",
+              file=sys.stderr)
+    print(json.dumps(doc))
+    return 0 if doc["gate"]["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

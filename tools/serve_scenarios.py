@@ -17,7 +17,14 @@ Drives the serve engine (and its speculative variant,
   nothing),
 - the **int8 KV cache** on/off,
 - **speculative decoding** on/off (truncated layer-skip draft,
-  ``k`` proposals/round)
+  ``k`` proposals/round),
+- **cross-request prefix sharing** exercised two ways: a multi-turn
+  chat column (turn 2 resubmits turn 1's prompt + streamed reply —
+  the content index must match the whole history) and a
+  common-system-prompt burst column (every request shares a
+  block-aligned system prefix).  Every cell whose engine runs the
+  prefix cache records a ``prefix`` block (probes/hits/hit_rate,
+  schema-validated: the rate must re-derive from the counts)
 
 — and emits one schema-valid document (``apex_tpu/analysis/
 scenario.py``, validated by ``tools/gate_hygiene.py`` in tier-1) in
@@ -98,20 +105,31 @@ def trained_model(tiny: bool):
     return train_toy_lm(gpt_tiny() if tiny else gpt_small_tpu())
 
 
-def _requests(ids, context, new_tokens, n, sampling):
+def _requests(ids, context, new_tokens, n, sampling,
+              shared_system=False, block_size=4):
     """``n`` requests whose prompts come from the training stream
     (predictable for the draft), lengths alternating full/0.75 of the
-    cell's prompt budget, knobs per the cell's sampling mode."""
+    cell's prompt budget, knobs per the cell's sampling mode.  With
+    ``shared_system`` every prompt opens with the SAME block-aligned
+    system prefix (half the budget) — the chat-service shape the
+    prefix-sharing columns exercise."""
     from apex_tpu.serve import Request
 
     plen_full = context - new_tokens
+    sys_len = max((plen_full // 2) // block_size * block_size,
+                  block_size) if shared_system else 0
+    system = np.asarray(
+        [ids[0][j % ids[0].shape[0]] for j in range(sys_len)],
+        np.int32)
     reqs = []
     rng = np.random.RandomState(17)
     for i in range(n):
         plen = max(2, int(plen_full * (0.75 + 0.25 * ((i + 1) % 2))))
         row = ids[i % ids.shape[0]]
-        prompt = np.asarray(
-            [row[j % row.shape[0]] for j in range(plen)], np.int32)
+        tail = np.asarray(
+            [row[j % row.shape[0]] for j in range(plen - sys_len)],
+            np.int32)
+        prompt = np.concatenate([system, tail]) if sys_len else tail
         kw = {}
         if sampling == "mixed" and i % 2 == 1:
             kw = dict(temperature=0.8, top_k=20,
@@ -123,11 +141,15 @@ def _requests(ids, context, new_tokens, n, sampling):
 
 def run_cell(cfg, params, draft, reqs, *, context, new_tokens,
              num_slots, arrival, sampling, kv8, spec, churn, spec_k,
-             block_size=4):
+             block_size=4, chat=False):
     """One scenario cell: build a fresh engine of the cell's shape,
     drive the request stream ``reqs`` with the cell's arrival process,
     and return the schema's cell record (numbers + the derived
-    gate)."""
+    gate).  Under ``chat`` a second turn follows the first: each
+    request resubmits its own prompt + streamed reply + a recycled
+    user turn, so the content index must match the whole history
+    (prompt blocks registered at arm, reply blocks at decode block
+    boundaries)."""
     from apex_tpu.obs.metrics import Registry
     from apex_tpu.serve import (ServeConfig, ServeEngine, SpecConfig,
                                 SpecEngine)
@@ -148,7 +170,12 @@ def run_cell(cfg, params, draft, reqs, *, context, new_tokens,
         num_slots=num_slots, block_size=block_size,
         num_blocks=num_blocks, max_blocks_per_slot=mb,
         prefill_chunk=min(64, max(block_size, context - new_tokens)),
-        kv_dtype="int8" if kv8 else None)
+        kv_dtype="int8" if kv8 else None,
+        # churn pins sharing OFF: the training-stream prompts repeat
+        # rows, so the content index would dedupe them and absorb the
+        # engineered block shortage — and this column exists to
+        # measure the preempt/recompute path, not prefix reuse
+        prefix_cache=not churn)
     reg = Registry()
     if spec:
         dp, dcfg = draft
@@ -188,14 +215,33 @@ def run_cell(cfg, params, draft, reqs, *, context, new_tokens,
     slo_ev.evaluate()
     t0 = time.perf_counter()
     guard = 0
+    done = {}
     while pending or not eng.sched.idle():
         if pending:
             eng.submit(pending.pop(0))
-        eng.step()
+        done.update(eng.step())
         slo_ev.evaluate()
         guard += 1
         if guard > 100_000:
             raise RuntimeError("scenario cell stalled")
+    if chat:
+        # turn 2 of the chat: history (prompt + reply) + a recycled
+        # user turn, through the SAME engine — the turn-1 blocks are
+        # cached (refcount 0, still matchable) after retirement
+        from apex_tpu.serve import Request
+        for r in reqs:
+            out = np.asarray(done[r.uid], np.int32)
+            prompt2 = np.concatenate(
+                [np.asarray(r.prompt, np.int32), out,
+                 np.asarray(r.prompt[:block_size], np.int32)])
+            eng.submit(Request(uid=f"{r.uid}t2", prompt=prompt2,
+                               max_new_tokens=new_tokens))
+        while not eng.sched.idle():
+            done.update(eng.step())
+            slo_ev.evaluate()
+            guard += 1
+            if guard > 100_000:
+                raise RuntimeError("scenario chat turn stalled")
     wall = time.perf_counter() - t0
     decode_steps = int(hist.count - mark[2])
     decode_tokens = int(toks.value - tok0)
@@ -244,6 +290,16 @@ def run_cell(cfg, params, draft, reqs, *, context, new_tokens,
     if spec:
         rec["acceptance_rate"] = round(
             float(reg.gauge("serve_spec_acceptance_rate").value), 4)
+    # every engine running the prefix cache reports its cell-level
+    # hit accounting (schema-validated: the rate must re-derive)
+    if getattr(eng.sched, "prefix_cache", False):
+        probes = int(eng.sched.prefix_probes)
+        rec["prefix"] = {
+            "probes": probes,
+            "hits": int(eng.sched.prefix_hits),
+            "hit_rate": round(
+                eng.sched.prefix_hits / max(probes, 1), 6),
+        }
     return rec
 
 
@@ -265,6 +321,19 @@ def cell_matrix(full: bool):
          dict(context=128, new_tokens=16, arrival="burst",
               sampling="greedy", kv8=False, churn=True,
               num_slots=3), False),
+        # the prefix-sharing columns: multi-turn chat (turn 2 reuses
+        # the whole turn-1 history through the content index) and a
+        # common-system-prompt burst (every request shares a
+        # block-aligned prefix) — each carries its cell-level
+        # prefix_hit_rate, schema-validated against its own counts
+        ("ctx128_multiturn_chat",
+         dict(context=128, new_tokens=16, arrival="steady",
+              sampling="greedy", kv8=False, churn=False,
+              chat=True), False),
+        ("ctx128_burst_sysprompt",
+         dict(context=128, new_tokens=16, arrival="burst",
+              sampling="greedy", kv8=False, churn=False,
+              sysprompt=True), False),
         ("ctx512_steady_greedy",
          dict(context=512, new_tokens=16, arrival="steady",
               sampling="greedy", kv8=False, churn=False), True),
@@ -299,20 +368,27 @@ def sweep(tiny: bool, full: bool, spec_k: int, verbose: bool = True):
         num_slots = knobs.pop("num_slots", 2)
         n_requests = knobs.pop("n_requests", None)
         block_size = knobs.pop("block_size", 4)
+        sysprompt = knobs.pop("sysprompt", False)
+        chat = knobs.pop("chat", False)
         # churn cells run half-context requests (the pool is sized to
-        # cover exactly two of their footprints — see run_cell);
-        # config.context stays the cell's context CAPACITY
-        req_ctx = knobs["context"] // 2 if knobs["churn"] \
+        # cover exactly two of their footprints — see run_cell); chat
+        # cells too, so turn 2 (history + reply + next turn) still
+        # fits the per-slot footprint; config.context stays the
+        # cell's context CAPACITY
+        req_ctx = knobs["context"] // 2 if (knobs["churn"] or chat) \
             else knobs["context"]
         reqs = _requests(ids, req_ctx, knobs["new_tokens"],
-                         n_requests or 2 * num_slots, knobs["sampling"])
+                         n_requests or 2 * num_slots, knobs["sampling"],
+                         shared_system=sysprompt,
+                         block_size=block_size)
         pair = {}
         for spec in (False, True):
             cell_name = f"{name}_spec" if spec else name
             t0 = time.perf_counter()
             rec = run_cell(cfg, params, draft, list(reqs),
                            num_slots=num_slots, block_size=block_size,
-                           spec=spec, spec_k=spec_k, **knobs)
+                           spec=spec, spec_k=spec_k, chat=chat,
+                           **knobs)
             cells[cell_name] = rec
             pair[spec] = (cell_name, rec)
             if verbose:
