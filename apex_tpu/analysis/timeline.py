@@ -333,6 +333,33 @@ def _ingest_prefixcache(doc, prev) -> List[Row]:
     return rows
 
 
+@adapter("TRAINFLEET")
+def _ingest_trainfleet(doc, prev) -> List[Row]:
+    """Elastic-fleet chaos rounds: the drill's wall clock, generation
+    count, per-recovery steps-lost (bounded by the checkpoint
+    interval), and the bitwise verdicts as 1.0/0.0 — the longitudinal
+    record of what a rank kill costs."""
+    rows: List[Row] = []
+    if _num(doc.get("wall_s")):
+        rows.append(("drill", "wall_s", float(doc["wall_s"])))
+    gens = doc.get("generations")
+    if isinstance(gens, list):
+        rows.append(("drill", "generations", float(len(gens))))
+    for rec in (doc.get("recoveries") or []):
+        if isinstance(rec, dict) and _num(rec.get("steps_lost")):
+            rows.append((str(rec.get("reason", "recovery")),
+                         "steps_lost", float(rec["steps_lost"])))
+    bitwise = doc.get("bitwise")
+    if isinstance(bitwise, dict):
+        rows.extend(("bitwise", k, float(v))
+                    for k, v in sorted(bitwise.items())
+                    if isinstance(v, bool))
+    gate = doc.get("gate")
+    if isinstance(gate, dict) and isinstance(gate.get("ok"), bool):
+        rows.append(("gate", "ok", float(gate["ok"])))
+    return rows
+
+
 @adapter("SCENARIO")
 def _ingest_scenario(doc, prev) -> List[Row]:
     rows: List[Row] = []

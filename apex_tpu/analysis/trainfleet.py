@@ -1,0 +1,416 @@
+"""TRAINFLEET_r*.json — schema for the committed elastic-fleet chaos
+drill (``tools/train_fleet.py``).
+
+One document per drill round: a real 2-process DDP + amp-O2 training
+run in which a rank was SIGKILLed mid-training, the surviving rank
+shrank onto the smaller mesh from the last durable step, the fleet
+regrew when the rank returned, and the recovery is **bitwise-audited**
+against uninterrupted replays of the same post-restore schedules.
+
+Like the other gate artifacts (MEMLINT, FLEETLINT, SCHED...), the
+document is *self-incriminating*: every verdict it stores must
+RE-DERIVE from the raw material it also stores, and a contradiction
+fails validation (and therefore tier-1, via ``tools/gate_hygiene.py``):
+
+- each recovery's ``steps_lost`` must equal ``interrupted_step -
+  restore_step``, the interrupted step must be a recorded ``kill``
+  event, the restore step must be the matching generation plan's, and
+  the loss must be within ``config.checkpoint_every`` — the durability
+  bound the fleet design promises;
+- generation membership must *chain*: a ``shrink`` generation's
+  members are a strict subset of its predecessor's, a ``regrow``
+  generation's a strict superset;
+- every ``bitwise`` flag must re-derive from the recorded sha256 state
+  digests (drill snapshots/finals vs replay finals);
+- ``gate.ok`` must equal the conjunction of the bitwise flags;
+- the embedded incidents must each satisfy the incident schema
+  (``apex_tpu/resilience/incidents.py``), cover the
+  ``fleet-shrink`` / ``fleet-restored`` / ``fleet-regrow`` statuses,
+  and their flight-recorder tails must contain the
+  ``kill`` / ``shrink_detected`` / ``restore`` / ``regrow_detected``
+  events the drill claims were recorded;
+- the regrow generation's ``aot`` events must all say
+  ``source == "cache"`` — a regrown rank *loads* its step, the elastic
+  claim the AOT cache exists to back.
+
+This module is deliberately **stdlib-only** (no jax import):
+``gate_hygiene`` loads it directly by file path; the incident
+sub-schema is loaded the same way (``resilience/incidents.py`` is
+itself stdlib-only).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+#: event kinds the drill's ledger log must contain for the story the
+#: artifact tells to be auditable at all
+REQUIRED_EVENT_KINDS = ("kill", "shrink_detected", "restore",
+                        "regrow_detected", "plan", "gen_complete")
+
+#: incident statuses the drill must have produced (one per transition)
+REQUIRED_INCIDENT_STATUSES = ("fleet-shrink", "fleet-restored",
+                              "fleet-regrow")
+
+#: per-status flight-recorder kinds that must appear in that
+#: incident's embedded tail
+_INCIDENT_FLIGHT_KINDS = {
+    "fleet-shrink": ("kill", "shrink_detected"),
+    "fleet-restored": ("restore",),
+    "fleet-regrow": ("regrow_detected",),
+}
+
+_BITWISE_FLAGS = ("shrink_matches_uninterrupted",
+                  "regrow_matches_uninterrupted",
+                  "final_cross_rank_identical")
+
+
+def _incidents_schema():
+    """Load ``resilience/incidents.py`` by file path (mirrors how
+    ``gate_hygiene`` loads THIS module — importing the ``apex_tpu``
+    package would drag jax into a stdlib-only checker)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "resilience", "incidents.py")
+    spec = importlib.util.spec_from_file_location(
+        "_trainfleet_incidents", os.path.abspath(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _is_digest(v: Any) -> bool:
+    return isinstance(v, str) and len(v) >= 32 and all(
+        c in "0123456789abcdef" for c in v)
+
+
+def _check_config(doc: dict, problems: List[str]) -> Optional[dict]:
+    cfg = doc.get("config")
+    if not isinstance(cfg, dict):
+        problems.append("missing/invalid 'config' object")
+        return None
+    for key, pred, want in (
+            ("num_steps", lambda v: isinstance(v, int) and v > 0,
+             "int > 0"),
+            ("checkpoint_every", lambda v: isinstance(v, int) and v > 0,
+             "int > 0"),
+            ("world_size", lambda v: isinstance(v, int) and v >= 2,
+             "int >= 2"),
+            ("lease_ttl_s", lambda v: isinstance(v, (int, float))
+             and v > 0, "number > 0"),
+            ("heartbeat_s", lambda v: isinstance(v, (int, float))
+             and v > 0, "number > 0")):
+        if not pred(cfg.get(key)):
+            problems.append(f"config.{key} missing/invalid (want {want}): "
+                            f"{cfg.get(key)!r}")
+            return None
+    return cfg
+
+
+def _check_generations(doc: dict, cfg: dict, problems: List[str]
+                       ) -> Optional[List[dict]]:
+    gens = doc.get("generations")
+    if not (isinstance(gens, list) and len(gens) >= 3):
+        problems.append("'generations' must list >= 3 entries "
+                        "(initial, shrink, regrow)")
+        return None
+    snapshots = doc.get("snapshots") or {}
+    for i, g in enumerate(gens):
+        if not isinstance(g, dict):
+            problems.append(f"generations[{i}] is not an object")
+            return None
+        if g.get("gen") != i:
+            problems.append(f"generations[{i}].gen={g.get('gen')!r} "
+                            f"(generations must be dense, in order)")
+            return None
+        members = g.get("members")
+        if not (isinstance(members, list) and members and all(
+                isinstance(r, int) for r in members)):
+            problems.append(f"generations[{i}].members missing/invalid")
+            return None
+        if g.get("reason") not in ("initial", "shrink", "regrow",
+                                   "reform"):
+            problems.append(f"generations[{i}].reason invalid: "
+                            f"{g.get('reason')!r}")
+            return None
+        if i == 0:
+            if len(members) != cfg["world_size"]:
+                problems.append(
+                    f"generation 0 has {len(members)} members but "
+                    f"config.world_size={cfg['world_size']}")
+        else:
+            rs = g.get("restore_step")
+            if not isinstance(rs, int):
+                problems.append(f"generations[{i}].restore_step must be "
+                                f"an int (a replan without a durable "
+                                f"step to restore is not a recovery)")
+            elif str(rs) not in snapshots:
+                problems.append(
+                    f"generations[{i}].restore_step={rs} has no recorded "
+                    f"snapshot digest (snapshots: "
+                    f"{sorted(snapshots)[:8]})")
+            prev = set(gens[i - 1]["members"])
+            cur = set(members)
+            if g["reason"] == "shrink" and not cur < prev:
+                problems.append(
+                    f"generations[{i}] says 'shrink' but members {sorted(cur)} "
+                    f"are not a strict subset of {sorted(prev)}")
+            if g["reason"] == "regrow" and not cur > prev:
+                problems.append(
+                    f"generations[{i}] says 'regrow' but members {sorted(cur)} "
+                    f"are not a strict superset of {sorted(prev)}")
+    return gens
+
+
+def _check_recoveries(doc: dict, cfg: dict, gens: List[dict],
+                      problems: List[str]) -> None:
+    recs = doc.get("recoveries")
+    if not (isinstance(recs, list) and recs):
+        problems.append("missing/empty 'recoveries' list")
+        return
+    kill_steps = {e.get("step") for e in doc.get("events", [])
+                  if isinstance(e, dict) and e.get("kind") == "kill"}
+    if not any(isinstance(r, dict) and r.get("reason") == "shrink"
+               for r in recs):
+        problems.append("no 'shrink' recovery recorded — the drill's "
+                        "whole point")
+    for i, r in enumerate(recs):
+        if not isinstance(r, dict):
+            problems.append(f"recoveries[{i}] is not an object")
+            continue
+        g = r.get("generation")
+        if not (isinstance(g, int) and 0 < g < len(gens)):
+            problems.append(f"recoveries[{i}].generation invalid: {g!r}")
+            continue
+        gen = gens[g]
+        if r.get("reason") != gen["reason"]:
+            problems.append(
+                f"recoveries[{i}].reason={r.get('reason')!r} contradicts "
+                f"generations[{g}].reason={gen['reason']!r}")
+        if r.get("restore_step") != gen.get("restore_step"):
+            problems.append(
+                f"recoveries[{i}].restore_step={r.get('restore_step')!r} "
+                f"contradicts generations[{g}].restore_step="
+                f"{gen.get('restore_step')!r}")
+        want_ranks = sorted(set(gens[g - 1]["members"])
+                            ^ set(gen["members"]))
+        if r.get("ranks") != want_ranks:
+            problems.append(
+                f"recoveries[{i}].ranks={r.get('ranks')!r} contradicts the "
+                f"generation membership delta {want_ranks}")
+        if r.get("reason") == "shrink":
+            istep = r.get("interrupted_step")
+            if istep not in kill_steps:
+                problems.append(
+                    f"recoveries[{i}].interrupted_step={istep!r} is not a "
+                    f"recorded 'kill' event step ({sorted(kill_steps)})")
+                continue
+            derived = istep - gen["restore_step"]
+            if r.get("steps_lost") != derived:
+                problems.append(
+                    f"recoveries[{i}].steps_lost={r.get('steps_lost')!r} "
+                    f"contradicts interrupted_step - restore_step = "
+                    f"{derived}")
+            if derived < 0 or derived > cfg["checkpoint_every"]:
+                problems.append(
+                    f"recoveries[{i}]: {derived} steps lost violates the "
+                    f"durability bound (0 <= lost <= checkpoint_every="
+                    f"{cfg['checkpoint_every']})")
+
+
+def _check_bitwise(doc: dict, cfg: dict, gens: List[dict],
+                   problems: List[str]) -> None:
+    snapshots = doc.get("snapshots")
+    if not (isinstance(snapshots, dict) and snapshots and all(
+            k.isdigit() and _is_digest(v) for k, v in snapshots.items())):
+        problems.append("missing/invalid 'snapshots' "
+                        "({step: sha256} of committed drill snapshots)")
+        return
+    finals = doc.get("finals")
+    last_members = [str(r) for r in gens[-1]["members"]]
+    if not (isinstance(finals, dict)
+            and sorted(finals) == sorted(last_members)):
+        problems.append(
+            f"'finals' must record exactly the last generation's members "
+            f"{sorted(last_members)} (got "
+            f"{sorted(finals) if isinstance(finals, dict) else finals!r})")
+        return
+    for r, f in finals.items():
+        if not (isinstance(f, dict) and _is_digest(f.get("digest"))
+                and f.get("step") == cfg["num_steps"] - 1):
+            problems.append(
+                f"finals[{r!r}] must carry step={cfg['num_steps'] - 1} "
+                f"and a sha256 digest: {f!r}")
+            return
+
+    replays = doc.get("replays")
+    if not (isinstance(replays, dict) and isinstance(
+            replays.get("shrink"), dict) and isinstance(
+            replays.get("regrow"), dict)):
+        problems.append("missing 'replays' object with 'shrink' and "
+                        "'regrow' records")
+        return
+    shrink_gen = next((g for g in gens if g["reason"] == "shrink"), None)
+    regrow_gen = next((g for g in reversed(gens)
+                       if g["reason"] == "regrow"), None)
+    if shrink_gen is None or regrow_gen is None:
+        problems.append("generations record no shrink/regrow pair to "
+                        "audit the replays against")
+        return
+    rs, rg = replays["shrink"], replays["regrow"]
+    for name, rep, want_restore, want_final, want_world in (
+            ("shrink", rs, shrink_gen["restore_step"],
+             regrow_gen["restore_step"], len(shrink_gen["members"])),
+            ("regrow", rg, regrow_gen["restore_step"],
+             cfg["num_steps"] - 1, len(regrow_gen["members"]))):
+        if rep.get("restore_step") != want_restore:
+            problems.append(
+                f"replays.{name}.restore_step={rep.get('restore_step')!r} "
+                f"contradicts the generation plan's {want_restore}")
+        if rep.get("final_step") != want_final:
+            problems.append(
+                f"replays.{name}.final_step={rep.get('final_step')!r} != "
+                f"{want_final} (it must cover exactly the schedule the "
+                f"drill ran)")
+        if rep.get("world") != want_world:
+            problems.append(
+                f"replays.{name}.world={rep.get('world')!r} != "
+                f"{want_world} (the generation's world size)")
+        rfin = rep.get("finals")
+        if not (isinstance(rfin, dict) and rfin and all(
+                isinstance(f, dict) and _is_digest(f.get("digest"))
+                for f in rfin.values())):
+            problems.append(f"replays.{name}.finals missing/invalid")
+            return
+
+    bitwise = doc.get("bitwise")
+    if not (isinstance(bitwise, dict) and all(
+            isinstance(bitwise.get(k), bool) for k in _BITWISE_FLAGS)):
+        problems.append(f"'bitwise' must carry bools {_BITWISE_FLAGS}")
+        return
+    # -- the re-derivation rules (contradiction rejection) --------------
+    shrink_digests = {f["digest"] for f in rs["finals"].values()}
+    derived_shrink = (len(shrink_digests) == 1 and shrink_digests ==
+                      {snapshots.get(str(regrow_gen["restore_step"]))})
+    derived_regrow = (sorted(rg["finals"]) == sorted(finals) and all(
+        rg["finals"][r]["digest"] == finals[r]["digest"] for r in finals))
+    derived_cross = len({f["digest"] for f in finals.values()}) == 1
+    for flag, derived in (
+            ("shrink_matches_uninterrupted", derived_shrink),
+            ("regrow_matches_uninterrupted", derived_regrow),
+            ("final_cross_rank_identical", derived_cross)):
+        if bitwise[flag] != derived:
+            problems.append(
+                f"bitwise.{flag}={bitwise[flag]} contradicts the recorded "
+                f"digests (which derive {derived})")
+
+    gate = doc.get("gate")
+    if not (isinstance(gate, dict) and isinstance(gate.get("ok"), bool)):
+        problems.append("missing/invalid 'gate.ok' (bool)")
+        return
+    derived_ok = all(bitwise[k] for k in _BITWISE_FLAGS)
+    if gate["ok"] != derived_ok:
+        problems.append(f"gate.ok={gate['ok']} contradicts the bitwise "
+                        f"flags (which derive {derived_ok})")
+
+
+def _check_events(doc: dict, gens: List[dict],
+                  problems: List[str]) -> None:
+    events = doc.get("events")
+    if not (isinstance(events, list) and events):
+        problems.append("missing/empty 'events' list (the ledger log)")
+        return
+    kinds = {e.get("kind") for e in events if isinstance(e, dict)}
+    missing = [k for k in REQUIRED_EVENT_KINDS if k not in kinds]
+    if missing:
+        problems.append(f"event log never recorded {missing} "
+                        f"(kinds seen: {sorted(k for k in kinds if k)})")
+    # the regrown generation must have LOADED its step, not compiled it
+    last_gen = gens[-1]["gen"]
+    aot = [e for e in events if isinstance(e, dict)
+           and e.get("kind") == "aot" and e.get("gen") == last_gen]
+    if len(aot) < len(gens[-1]["members"]):
+        problems.append(
+            f"generation {last_gen} has {len(aot)} 'aot' events for "
+            f"{len(gens[-1]['members'])} members — a rank's "
+            f"load-vs-compile story is unrecorded")
+    for e in aot:
+        if e.get("source") != "cache":
+            problems.append(
+                f"generation {last_gen} rank {e.get('rank')} compiled its "
+                f"step (aot source={e.get('source')!r}) — a regrown rank "
+                f"must LOAD from the AOT cache")
+
+
+def _check_incidents(doc: dict, problems: List[str]) -> None:
+    incs = doc.get("incidents")
+    if not (isinstance(incs, list) and incs):
+        problems.append("missing/empty 'incidents' list")
+        return
+    try:
+        schema = _incidents_schema()
+    except Exception as e:  # noqa: BLE001 - name the load failure
+        problems.append(f"cannot load the incident sub-schema: {e!r}")
+        return
+    by_status: Dict[str, List[dict]] = {}
+    for i, rec in enumerate(incs):
+        sub = schema.validate_incident(rec)
+        if sub:
+            problems.append(f"incidents[{i}] invalid: {sub[:2]}")
+            continue
+        by_status.setdefault(rec["status"], []).append(rec)
+    for status in REQUIRED_INCIDENT_STATUSES:
+        if status not in by_status:
+            problems.append(
+                f"no {status!r} incident recorded (statuses present: "
+                f"{sorted(by_status)})")
+            continue
+        want = _INCIDENT_FLIGHT_KINDS[status]
+        covered = any(
+            set(want) <= {ev.get("kind")
+                          for ev in (rec.get("flight") or {})
+                          .get("events", []) if isinstance(ev, dict)}
+            for rec in by_status[status])
+        if not covered:
+            problems.append(
+                f"no {status!r} incident's flight tail contains the "
+                f"{list(want)} events it exists to record")
+
+
+def validate_trainfleet(doc) -> List[str]:
+    """Problems with one parsed TRAINFLEET document (empty = valid)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not a JSON object"]
+    if doc.get("artifact") != "TRAINFLEET":
+        problems.append(f"'artifact' must be 'TRAINFLEET' "
+                        f"(got {doc.get('artifact')!r})")
+    if not (isinstance(doc.get("round"), int) and doc["round"] >= 1):
+        problems.append("missing/invalid 'round' (int >= 1)")
+    if not isinstance(doc.get("platform"), str):
+        problems.append("missing/invalid 'platform' (str)")
+    if not isinstance(doc.get("generated_utc"), str):
+        problems.append("missing/invalid 'generated_utc' (str)")
+    cfg = _check_config(doc, problems)
+    if cfg is None:
+        return problems
+    gens = _check_generations(doc, cfg, problems)
+    if gens is None:
+        return problems
+    _check_events(doc, gens, problems)
+    _check_recoveries(doc, cfg, gens, problems)
+    _check_bitwise(doc, cfg, gens, problems)
+    _check_incidents(doc, problems)
+    return problems
+
+
+def validate_trainfleet_file(path: str) -> List[str]:
+    """Problems with one TRAINFLEET_r*.json file (empty = valid)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable trainfleet JSON: {e}"]
+    return validate_trainfleet(doc)

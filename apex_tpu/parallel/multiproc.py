@@ -347,6 +347,16 @@ def spawn(argslist: Sequence[str], world_size: Optional[int] = None,
     indistinguishable from one that never started.  The same cleanup
     (terminate, reap, close logs) runs if the launcher is interrupted or
     a launch step fails.
+
+    Zombie-peer reaping: a rank that wedges in a collective AFTER a
+    peer exited cleanly (its partner is gone, so the collective can
+    never complete — the all-zero twin of the crash case above) is
+    bounded by a grace window instead of hanging the launcher forever.
+    Once the first rank exits 0, the stragglers get
+    ``APEX_TPU_SPAWN_GRACE_S`` seconds (default 60) to follow; then
+    they are terminated (SIGTERM, 5s, SIGKILL), and spawn raises a
+    :class:`ClusterInitError` naming the wedged ranks — within the
+    watchdog budget, not past test teardown.
     """
     argslist = list(argslist)
     if world_size is None:
@@ -396,12 +406,37 @@ def spawn(argslist: Sequence[str], world_size: Optional[int] = None,
         # the rest of the cluster blocked in jax.distributed.initialize
         # waiting for it — fail fast and tear the others down instead.
         import time
+        grace_s = float(os.environ.get("APEX_TPU_SPAWN_GRACE_S", "60"))
+        first_done: Optional[float] = None
         while True:
             codes = [p.poll() for p in workers]
             if all(c is not None for c in codes):
                 if any(c != 0 for c in codes):
                     _raise_first_failure(codes)
                 return codes
+            if any(c == 0 for c in codes):
+                if first_done is None:
+                    first_done = time.monotonic()
+                elif time.monotonic() - first_done > grace_s:
+                    # zombie peers: their partner is gone, the pending
+                    # collective can never complete — reap, don't hang
+                    wedged = [i for i, c in enumerate(codes) if c is None]
+                    for p in workers:
+                        if p.poll() is None:
+                            p.terminate()
+                    for p in workers:
+                        try:
+                            p.wait(timeout=5)
+                        except subprocess.TimeoutExpired:
+                            p.kill()
+                            p.wait()
+                    raise ClusterInitError(
+                        f"ranks {wedged} still running {grace_s:g}s after "
+                        f"rank {codes.index(0)} exited cleanly (exit codes "
+                        f"{codes}): wedged in a collective whose peer is "
+                        f"gone; terminated.  rank {wedged[0]} stderr tail "
+                        f"({err_paths[wedged[0]]}):\n"
+                        f"{_stderr_tail(err_paths[wedged[0]])}")
             if any(c not in (None, 0) for c in codes):
                 first_bad = list(codes)   # snapshot at detection time:
                 for p in workers:         # peers killed below get -15,

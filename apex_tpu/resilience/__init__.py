@@ -13,7 +13,10 @@ flaky checkpoint IO):
 - :mod:`~apex_tpu.resilience.loop` — the self-healing train loop
   (:func:`run_resilient`: watchdog, IO retry, divergence rewind);
 - :mod:`~apex_tpu.resilience.incidents` — the machine-checkable incident
-  artifact schema shared with ``tools/gate_hygiene.py``.
+  artifact schema shared with ``tools/gate_hygiene.py``;
+- :mod:`~apex_tpu.resilience.fleet` — the elastic training fleet
+  (heartbeat-leased membership, shrink on preemption, regrow on
+  recovery; :func:`supervise` / :func:`run_generation`).
 """
 
 from apex_tpu.resilience.durable import (CheckpointCorruptError,
@@ -22,8 +25,15 @@ from apex_tpu.resilience.durable import (CheckpointCorruptError,
                                          write_snapshot)
 from apex_tpu.resilience.faults import (CorruptCheckpoint, FaultInjector,
                                         FlakyIO, HangStep, NaNStorm,
-                                        Preempt, SimulatedPreemption,
-                                        SlowIO)
+                                        Preempt, RankKill,
+                                        SimulatedPreemption, SlowIO,
+                                        parse_fault)
+from apex_tpu.resilience.fleet import (FleetConfig, FleetError,
+                                       FleetLedger, FleetMembershipChange,
+                                       FleetMetrics, HeartbeatLease,
+                                       latest_verified_step, membership_gate,
+                                       run_generation, snapshot_digest,
+                                       state_digest, supervise)
 from apex_tpu.resilience.incidents import (make_incident, validate_incident,
                                            validate_incident_file,
                                            write_incident)
@@ -35,7 +45,11 @@ __all__ = [
     "CheckpointCorruptError", "DurableCheckpointManager", "read_snapshot",
     "verify_snapshot", "write_snapshot",
     "CorruptCheckpoint", "FaultInjector", "FlakyIO", "HangStep", "NaNStorm",
-    "Preempt", "SimulatedPreemption", "SlowIO",
+    "Preempt", "RankKill", "SimulatedPreemption", "SlowIO", "parse_fault",
+    "FleetConfig", "FleetError", "FleetLedger", "FleetMembershipChange",
+    "FleetMetrics", "HeartbeatLease", "latest_verified_step",
+    "membership_gate", "run_generation", "snapshot_digest", "state_digest",
+    "supervise",
     "make_incident", "validate_incident", "validate_incident_file",
     "write_incident",
     "DivergenceError", "ResilienceConfig", "RunResult", "WatchdogTimeout",

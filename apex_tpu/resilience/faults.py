@@ -31,8 +31,9 @@ from __future__ import annotations
 import dataclasses
 import os
 import random
+import signal as signal_mod
 import time
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 
 class SimulatedPreemption(RuntimeError):
@@ -78,6 +79,24 @@ class HangStep:
 
 
 @dataclasses.dataclass(frozen=True)
+class RankKill:
+    """Hard-kill a real fleet rank at the start of ``step`` — the
+    elastic-fleet drill's preemption (``tools/train_fleet.py``).  Unlike
+    :class:`Preempt` (an in-process exception the same loop catches),
+    this is SIGKILL: no handlers, no flushes, the process is simply
+    gone — which is what an actual TPU preemption looks like to the
+    surviving ranks.  ``rank`` scopes the fault (None = whichever rank's
+    injector sees the step); ``kill_parent`` also kills the rank's
+    supervisor process so the heartbeat lease actually goes stale (a
+    child-only kill leaves the lease beating and models a *stall*, not
+    a preemption)."""
+    step: int
+    rank: Optional[int] = None
+    signal: int = signal_mod.SIGKILL
+    kill_parent: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
 class FlakyIO:
     """First ``fails`` IO calls of ``op`` raise ``OSError`` — exercises
     the loop's retry-with-backoff."""
@@ -97,19 +116,30 @@ class FaultInjector:
 
     Hooks (all no-ops when the fault list doesn't match):
 
-    - :meth:`on_step_start` — may sleep (:class:`HangStep`) or raise
-      (:class:`Preempt`); call first thing in the step.
+    - :meth:`on_step_start` — may sleep (:class:`HangStep`), raise
+      (:class:`Preempt`) or SIGKILL the process (:class:`RankKill`);
+      call first thing in the step.
     - :meth:`poison_batch`  — returns the (possibly poisoned) batch.
     - :meth:`io_hook`       — pass as ``DurableCheckpointManager(io_hook=...)``.
     - :meth:`on_commit`     — pass as ``DurableCheckpointManager(on_commit=...)``.
 
+    ``rank`` scopes rank-targeted faults (:class:`RankKill` with an
+    explicit ``rank`` only fires on the matching injector);
+    ``on_rank_kill`` is a seam for the fleet layer: when set, it is
+    called as ``on_rank_kill(fault, step)`` INSTEAD of the default
+    :meth:`execute_rank_kill`, so the caller can flush a forensic
+    record to disk before pulling the trigger.
+
     Usable directly as a context manager (enter/exit just guard against
     reuse and close the event log)."""
 
-    def __init__(self, faults: Sequence[Any] = (), seed: int = 0):
+    def __init__(self, faults: Sequence[Any] = (), seed: int = 0,
+                 rank: Optional[int] = None):
         self.faults = list(faults)
         self.rng = random.Random(seed)
+        self.rank = rank
         self.events: List[dict] = []
+        self.on_rank_kill: Optional[Callable[[RankKill, int], None]] = None
         self._storm_left = {id(f): f.duration for f in self.faults
                             if isinstance(f, NaNStorm)}
         self._flaky_left = {id(f): f.fails for f in self.faults
@@ -144,6 +174,28 @@ class FaultInjector:
                 self._fired_once.add(id(f))
                 self._record("preempt", step=step)
                 raise SimulatedPreemption(step)
+            elif isinstance(f, RankKill) and f.step == step \
+                    and (f.rank is None or f.rank == self.rank):
+                self._fired_once.add(id(f))
+                self._record("rank_kill", step=step, rank=self.rank,
+                             signal=int(f.signal),
+                             kill_parent=bool(f.kill_parent))
+                if self.on_rank_kill is not None:
+                    self.on_rank_kill(f, step)
+                else:
+                    self.execute_rank_kill(f)
+
+    def execute_rank_kill(self, fault: RankKill) -> None:
+        """The default :class:`RankKill` trigger: SIGKILL the parent
+        (the rank's supervisor — its death is what lets the heartbeat
+        lease expire) and then this process.  ``os.kill(self, SIGKILL)``
+        does not return; nothing after it runs."""
+        if fault.kill_parent:
+            try:
+                os.kill(os.getppid(), fault.signal)
+            except (OSError, ProcessLookupError):
+                pass
+        os.kill(os.getpid(), fault.signal)
 
     def poison_batch(self, step: int, batch: Tuple[Any, ...]
                      ) -> Tuple[Any, ...]:
@@ -202,3 +254,51 @@ class FaultInjector:
                     fh.write(bytes(b ^ 0xFF for b in chunk))
             self._record("corrupt_checkpoint", step=step, kind=f.kind,
                          file=os.path.basename(victim))
+
+
+def parse_fault(spec: str) -> Any:
+    """``name@step[:arg]`` / ``name[:arg]`` → fault dataclass — the ONE
+    injector vocabulary shared by the single-process chaos harness
+    (``tools/chaos_run.py``) and the fleet drill
+    (``tools/train_fleet.py`` / the ``--fleet`` lane):
+
+    - ``nan_storm@S[:D]``       — poison the batch for D firings from S
+    - ``ckpt_truncate@S`` / ``ckpt_corrupt@S`` — damage the first
+      checkpoint committed at/after S
+    - ``preempt@S``             — in-process SIGTERM analog at S
+    - ``rank_kill@S[:RANK]``    — SIGKILL a real fleet rank at S
+      (all ranks when RANK omitted)
+    - ``hang@S[:SEC]``          — host hang at S (watchdog prey)
+    - ``flaky_io[:N]``          — first N saves raise OSError
+    - ``slow_io[:SEC]``         — every save sleeps SEC first
+
+    Raises ``ValueError`` on an unknown name or a missing required
+    step (CLI front-ends wrap this into their usage error).
+    """
+    name, _, rest = spec.partition("@")
+    step_s, _, arg = rest.partition(":")
+    if not rest:          # no @: arg may ride on the name (flaky_io:3)
+        name, _, arg = spec.partition(":")
+        step_s = ""
+    step = int(step_s) if step_s else None
+    if step is None and name in ("nan_storm", "ckpt_truncate",
+                                 "ckpt_corrupt", "preempt", "rank_kill",
+                                 "hang"):
+        raise ValueError(f"fault {name!r} needs a step: {name}@STEP[:arg]")
+    if name == "nan_storm":
+        return NaNStorm(step=step, duration=int(arg) if arg else 6)
+    if name == "ckpt_truncate":
+        return CorruptCheckpoint(step=step, kind="truncate")
+    if name == "ckpt_corrupt":
+        return CorruptCheckpoint(step=step, kind="corrupt")
+    if name == "preempt":
+        return Preempt(step=step)
+    if name == "rank_kill":
+        return RankKill(step=step, rank=int(arg) if arg else None)
+    if name == "hang":
+        return HangStep(step=step, seconds=float(arg) if arg else 2.0)
+    if name == "flaky_io":
+        return FlakyIO(op="save", fails=int(arg) if arg else 2)
+    if name == "slow_io":
+        return SlowIO(op="save", seconds=float(arg) if arg else 0.05)
+    raise ValueError(f"unknown fault spec {spec!r}")

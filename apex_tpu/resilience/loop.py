@@ -128,6 +128,7 @@ def run_resilient(
     registry: Optional[obs_metrics.Registry] = None,
     flight: Optional[FlightRecorder] = None,
     profiler: Optional[Any] = None,
+    fleet_metrics: Optional[Any] = None,
 ) -> RunResult:
     """Drive ``step_fn(state, *batch) -> (state, metrics)`` for
     ``num_steps`` with the protections in the module docstring.
@@ -174,6 +175,14 @@ def run_resilient(
     are flushed and an incident recorded (status ``preempted`` /
     ``interrupted``) before re-raising — the next process's
     ``manager.restore`` lands on the last good snapshot.
+
+    ``fleet_metrics`` (an
+    :class:`apex_tpu.resilience.fleet.FleetMetrics`) hooks the elastic
+    fleet's ``train_fleet_*`` family into the same lag-resolved
+    boundaries: ``on_resolve()`` fires where the loop's own counters
+    update (re-asserting the active-ranks gauge from a host int) and
+    ``on_rewind()`` where a divergence rewind lands — both host-side
+    only, so the instrumented step's lowering stays syncs-clean.
     """
     cfg = config or ResilienceConfig()
     from apex_tpu import checkpoint as ckpt
@@ -402,6 +411,8 @@ def run_resilient(
         events.append({"event": "rewind", "to_step": restored,
                        "reason": reason, "rewind_count": rewinds})
         m_rewinds.inc()
+        if fleet_metrics is not None:
+            fleet_metrics.on_rewind()
         fr.note("rewind", to_step=restored, reason=reason,
                 rewind_count=rewinds)
         return new_state, restored + 1
@@ -434,6 +445,8 @@ def run_resilient(
         # scalar at this (lag-resolved) point — zero added syncs
         m_steps.inc()
         m_loss.set(loss)
+        if fleet_metrics is not None:
+            fleet_metrics.on_resolve()
         if overflow:
             m_over.inc()
         if t0 is not None:

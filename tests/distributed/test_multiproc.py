@@ -120,6 +120,35 @@ def jax_distributed():
     return jax.distributed
 
 
+def test_spawn_reaps_zombie_peer_after_grace(tmp_path, monkeypatch):
+    """Zombie-peer reaping (ISSUE 18 satellite): one rank exits clean,
+    its peer wedges forever in a collective whose partner is gone —
+    spawn must terminate the straggler within the grace window and
+    raise a ClusterInitError naming it, not hang the launcher until
+    test teardown."""
+    import time
+
+    from apex_tpu.parallel import multiproc
+
+    script = tmp_path / "wedge.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys, time
+        if os.environ["RANK"] == "0":
+            sys.exit(0)
+        time.sleep(300)   # deliberately wedged: the peer is gone forever
+    """))
+    monkeypatch.chdir(tmp_path)           # PROC_*.log land in tmp
+    monkeypatch.setenv("APEX_TPU_SPAWN_GRACE_S", "2")
+    t0 = time.monotonic()
+    with pytest.raises(multiproc.ClusterInitError) as ei:
+        multiproc.spawn([str(script)], world_size=2)
+    assert time.monotonic() - t0 < 60     # reaped within budget, no hang
+    msg = str(ei.value)
+    assert "ranks [1]" in msg
+    assert "wedged" in msg
+    assert "rank 0 exited cleanly" in msg
+
+
 @pytest.mark.skipif(os.environ.get("APEX_TPU_TEST_PLATFORM") not in (None, "cpu"),
                     reason="local spawner test runs on the CPU backend")
 def test_spawn_two_process_psum(tmp_path):

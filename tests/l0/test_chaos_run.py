@@ -132,3 +132,28 @@ def test_parse_fault_specs():
     assert chaos_run.parse_fault("flaky_io:3") == FlakyIO(op="save", fails=3)
     assert chaos_run.parse_fault("slow_io:0.2") == SlowIO(op="save",
                                                           seconds=0.2)
+
+
+def test_parse_fault_rank_kill_shared_vocabulary():
+    """ISSUE 18 satellite: the fleet drill's rank-kill fault parses
+    through the SAME grammar as every other spec (one injector
+    vocabulary for the in-process and fleet lanes)."""
+    from apex_tpu.resilience import RankKill
+    assert chaos_run.parse_fault("rank_kill@10:1") == RankKill(step=10,
+                                                              rank=1)
+    assert chaos_run.parse_fault("rank_kill@4") == RankKill(step=4)
+    with pytest.raises(SystemExit):
+        chaos_run.parse_fault("rank_kill")       # a kill needs a step
+    with pytest.raises(SystemExit):
+        chaos_run.parse_fault("warp_core@3")     # unknown fault name
+
+
+def test_fleet_lane_requires_exactly_one_rank_kill():
+    """``--fleet`` refuses to start the multi-process drill without
+    exactly one rank_kill fault (and nothing else): the other fault
+    kinds are not SPMD-consistent across a real process mesh."""
+    for faults in ([], ["nan_storm@3"], ["rank_kill@5", "rank_kill@9"],
+                   ["rank_kill@5", "hang@2:0.5"]):
+        with pytest.raises(SystemExit, match="exactly one rank_kill"):
+            chaos_run.main(["--fleet", "--faults", *faults]
+                           if faults else ["--fleet"])

@@ -1313,3 +1313,136 @@ def test_repo_prefixcache_validates():
     assert doc["sharing"]["admitted_requests_per_block"] \
         > doc["baseline"]["admitted_requests_per_block"]
     assert doc["bitwise_ok"] is True
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 18: TRAINFLEET_r*.json — the elastic-fleet chaos drill is gate memory
+# ---------------------------------------------------------------------------
+
+def _trainfleet_modules(repo):
+    """The trainfleet schema loads the incident sub-schema by relative
+    path, so the tmp checkout needs both modules in place."""
+    _analysis_module(repo, "trainfleet")
+    _incidents_module(repo)
+
+
+def _trainfleet_doc():
+    """The committed drill artifact is the schema's reference instance —
+    contradiction tests mutate a copy of the real thing, so they can
+    never drift from what the drill actually emits."""
+    return json.loads((REPO / "TRAINFLEET_r01.json").read_text())
+
+
+def test_committed_trainfleet_validated_against_schema(tmp_repo):
+    _trainfleet_modules(tmp_repo)
+    (tmp_repo / "TRAINFLEET_r07.json").write_text('{"round": 7}')
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "bad fleet drill record")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("TRAINFLEET_r07.json" in p
+               for p in verdict["invalid_trainfleets"])
+    assert gate_hygiene.main(["--repo", str(tmp_repo)]) == 1
+
+
+def test_trainfleet_typed_in_steps_lost_rejected(tmp_repo):
+    """``steps_lost`` must equal ``interrupted_step - restore_step`` —
+    a typed-in smaller loss is the lie the schema exists to reject."""
+    _trainfleet_modules(tmp_repo)
+    doc = _trainfleet_doc()
+    shrink = next(r for r in doc["recoveries"] if r["reason"] == "shrink")
+    shrink["steps_lost"] = 0
+    (tmp_repo / "TRAINFLEET_r08.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "optimistic fleet record")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("steps_lost" in p and "contradicts" in p
+               for p in verdict["invalid_trainfleets"])
+
+
+def test_trainfleet_contradictory_bitwise_rejected(tmp_repo):
+    """A ``bitwise`` verdict the recorded digests refute (here: a
+    shrink-replay digest that no longer matches the drill snapshot,
+    while the flag still says True) fails hygiene — and flipping
+    ``gate.ok`` against its own bitwise table fails the same way."""
+    _trainfleet_modules(tmp_repo)
+    doc = _trainfleet_doc()
+    rank0 = next(iter(doc["replays"]["shrink"]["finals"]))
+    doc["replays"]["shrink"]["finals"][rank0]["digest"] = "f" * 64
+    (tmp_repo / "TRAINFLEET_r08.json").write_text(json.dumps(doc))
+    contradicted_gate = _trainfleet_doc()
+    contradicted_gate["gate"]["ok"] = False
+    (tmp_repo / "TRAINFLEET_r09.json").write_text(
+        json.dumps(contradicted_gate))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "asserted fleet verdicts")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    probs = verdict["invalid_trainfleets"]
+    assert any("TRAINFLEET_r08" in p and
+               "bitwise.shrink_matches_uninterrupted" in p for p in probs)
+    assert any("TRAINFLEET_r09" in p and "gate.ok" in p for p in probs)
+
+
+def test_trainfleet_regrown_rank_must_load_from_aot_cache(tmp_repo):
+    """The elastic claim the AOT cache backs: a regrown generation that
+    COMPILED its step (``aot.source != "cache"``) is schema-invalid."""
+    _trainfleet_modules(tmp_repo)
+    doc = _trainfleet_doc()
+    last_gen = doc["generations"][-1]["gen"]
+    for e in doc["events"]:
+        if e.get("kind") == "aot" and e.get("gen") == last_gen:
+            e["source"] = "compile"
+    (tmp_repo / "TRAINFLEET_r08.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "cold fleet record")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("must LOAD from the AOT cache" in p
+               for p in verdict["invalid_trainfleets"])
+
+
+def test_trainfleet_membership_must_chain(tmp_repo):
+    """A 'shrink' generation whose members are not a strict subset of
+    its predecessor's is an incoherent story, not a recovery."""
+    _trainfleet_modules(tmp_repo)
+    doc = _trainfleet_doc()
+    shrink_gen = next(g for g in doc["generations"]
+                      if g["reason"] == "shrink")
+    shrink_gen["members"] = doc["generations"][0]["members"]
+    (tmp_repo / "TRAINFLEET_r08.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "unchained fleet record")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("strict subset" in p
+               for p in verdict["invalid_trainfleets"])
+
+
+def test_valid_trainfleet_passes_and_untracked_fails(tmp_repo):
+    _trainfleet_modules(tmp_repo)
+    (tmp_repo / "TRAINFLEET_r08.json").write_text(
+        json.dumps(_trainfleet_doc()))
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]            # parked-but-untracked
+    assert verdict["untracked"] == ["TRAINFLEET_r08.json"]
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "fleet drill round")
+    assert gate_hygiene.check(str(tmp_repo))["ok"]
+
+
+def test_repo_trainfleet_validates():
+    """The committed TRAINFLEET artifact is the schema's reference
+    instance, and its drill verdicts must HOLD: the kill was real, the
+    recovery stayed within one checkpoint interval, every bitwise flag
+    derived true (the ISSUE-18 acceptance bars ride this assertion)."""
+    assert gate_hygiene._validate_trainfleets(str(REPO)) == []
+    arts = sorted(REPO.glob("TRAINFLEET_r*.json"))
+    assert arts, "the fleet chaos-drill artifact must be committed"
+    doc = json.loads(arts[-1].read_text())
+    assert doc["gate"]["ok"] is True
+    assert all(doc["bitwise"].values())
+    shrink = next(r for r in doc["recoveries"] if r["reason"] == "shrink")
+    assert 0 <= shrink["steps_lost"] <= doc["config"]["checkpoint_every"]
+    assert any(e["kind"] == "kill" for e in doc["events"])

@@ -55,7 +55,7 @@ def test_every_committed_family_has_an_adapter():
                    "SCENARIO", "SERVE_DISAGG", "TRACE", "OBS",
                    "EXPORT", "CONVERGENCE", "DECODE_PROFILE",
                    "DECODE_DECOMPOSE", "BENCH_VARIANCE", "FLEETLINT",
-                   "PREFIXCACHE"):
+                   "PREFIXCACHE", "TRAINFLEET"):
         assert expect in fams, f"{expect} not ingested ({fams})"
     assert all(rec["files"] for rec in out["coverage"].values())
     assert sum(rec["rows"] for rec in out["coverage"].values()) > 100
@@ -106,6 +106,30 @@ def test_prefixcache_adapter_rows():
     assert ("sharing", "admitted_requests_per_block", 0.4) in rows
     assert ("prefix", "hit_rate", 0.75) in rows
     assert ("prefix", "hit_tokens", 31.0) in rows
+
+
+def test_trainfleet_adapter_rows():
+    """TRAINFLEET rounds chart the chaos drill's wall clock, generation
+    count, per-recovery steps-lost, and the bitwise verdicts as
+    1.0/0.0 — a round where recovery quietly loses more steps (or a
+    bitwise flag drops to 0) is a timeline regression, not prose."""
+    doc = {"round": 1, "platform": "cpu", "wall_s": 51.0,
+           "generations": [{"gen": 0}, {"gen": 1}, {"gen": 2}],
+           "recoveries": [
+               {"reason": "shrink", "steps_lost": 3},
+               {"reason": "regrow", "steps_lost": 1}],
+           "bitwise": {"shrink_matches_uninterrupted": True,
+                       "regrow_matches_uninterrupted": True,
+                       "final_cross_rank_identical": False},
+           "gate": {"ok": False}}
+    rows = timeline.ADAPTERS["TRAINFLEET"](doc, {})
+    assert ("drill", "wall_s", 51.0) in rows
+    assert ("drill", "generations", 3.0) in rows
+    assert ("shrink", "steps_lost", 3.0) in rows
+    assert ("regrow", "steps_lost", 1.0) in rows
+    assert ("bitwise", "final_cross_rank_identical", 0.0) in rows
+    assert ("bitwise", "shrink_matches_uninterrupted", 1.0) in rows
+    assert ("gate", "ok", 0.0) in rows
 
 
 def test_unknown_family_is_a_lint_error(tmp_path):

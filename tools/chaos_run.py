@@ -18,7 +18,18 @@ Fault specs (``--faults``, repeatable):
   scheduler restart (fresh process state, restore from disk, resume);
 - ``hang@S[:SEC]``       — host hang at step S (watchdog prey);
 - ``flaky_io[:N]``       — first N checkpoint saves raise OSError;
-- ``slow_io[:SEC]``      — every save sleeps SEC first.
+- ``slow_io[:SEC]``      — every save sleeps SEC first;
+- ``rank_kill@S[:RANK]`` — SIGKILL a real training process at step S
+  (the ``--fleet`` lane only: the single-process lane has no peer to
+  survive the kill).
+
+``--fleet`` switches the harness from the in-process loop to the REAL
+multi-process elastic-fleet drill (``tools/train_fleet.py``): the one
+scheduled ``rank_kill`` fault is executed as an actual ``SIGKILL`` on a
+live ``jax.distributed`` rank, the survivor shrinks, the returned rank
+regrows, and the emitted ``TRAINFLEET_r*.json`` artifact is validated
+by ``apex_tpu/analysis/trainfleet.py``.  Both lanes share one fault
+vocabulary (:func:`apex_tpu.resilience.faults.parse_fault`).
 
 ``--overhead`` additionally measures the resilience wrapper's normal-path
 cost (bare jitted loop vs ``run_resilient`` with no faults and no
@@ -59,33 +70,44 @@ import numpy as np  # noqa: E402
 
 
 def parse_fault(spec: str):
-    """``name@step[:arg]`` / ``name[:arg]`` → fault dataclass."""
-    from apex_tpu.resilience import (CorruptCheckpoint, FlakyIO, HangStep,
-                                     NaNStorm, Preempt, SlowIO)
-    name, _, rest = spec.partition("@")
-    step_s, _, arg = rest.partition(":")
-    if not rest:          # no @: arg may ride on the name (flaky_io:3)
-        name, _, arg = spec.partition(":")
-        step_s = ""
-    step = int(step_s) if step_s else None
-    if step is None and name in ("nan_storm", "ckpt_truncate",
-                                 "ckpt_corrupt", "preempt", "hang"):
-        raise SystemExit(f"fault {name!r} needs a step: {name}@STEP[:arg]")
-    if name == "nan_storm":
-        return NaNStorm(step=step, duration=int(arg) if arg else 6)
-    if name == "ckpt_truncate":
-        return CorruptCheckpoint(step=step, kind="truncate")
-    if name == "ckpt_corrupt":
-        return CorruptCheckpoint(step=step, kind="corrupt")
-    if name == "preempt":
-        return Preempt(step=step)
-    if name == "hang":
-        return HangStep(step=step, seconds=float(arg) if arg else 2.0)
-    if name == "flaky_io":
-        return FlakyIO(op="save", fails=int(arg) if arg else 2)
-    if name == "slow_io":
-        return SlowIO(op="save", seconds=float(arg) if arg else 0.05)
-    raise SystemExit(f"unknown fault spec {spec!r}")
+    """``name@step[:arg]`` / ``name[:arg]`` → fault dataclass.  The
+    vocabulary lives in :func:`apex_tpu.resilience.faults.parse_fault`
+    (one grammar for this harness AND the fleet drill); this shim just
+    turns its ``ValueError`` into a CLI usage error."""
+    from apex_tpu.resilience.faults import parse_fault as _parse
+    try:
+        return _parse(spec)
+    except ValueError as e:
+        raise SystemExit(str(e))
+
+
+def _run_fleet_lane(args) -> int:
+    """The ``--fleet`` chaos lane: delegate to the elastic-fleet drill
+    harness with the ``rank_kill`` fault translated from the shared
+    injector vocabulary.  Exactly one ``rank_kill@S[:RANK]`` must be
+    scheduled; the other fault kinds belong to the in-process lane."""
+    from apex_tpu.resilience.faults import RankKill
+
+    faults = [parse_fault(s) for s in args.faults]
+    kills = [f for f in faults if isinstance(f, RankKill)]
+    if len(kills) != 1 or len(faults) != len(kills):
+        raise SystemExit(
+            "--fleet takes exactly one rank_kill@STEP[:RANK] fault and "
+            f"no others (got --faults {args.faults or 'none'}); the "
+            "in-process fault kinds run without --fleet")
+    kill = kills[0]
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_apex_train_fleet", str(REPO / "tools" / "train_fleet.py"))
+    train_fleet = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(train_fleet)
+    return train_fleet.main([
+        "--steps", str(args.steps),
+        "--checkpoint-every", str(args.checkpoint_every),
+        "--kill-step", str(kill.step),
+        "--kill-rank", str(kill.rank if kill.rank is not None else 1),
+        "--seed", str(args.seed),
+        "--out", args.out])
 
 
 def build_workload(seed: int = 0, min_loss_scale: float = 2.0 ** 14,
@@ -205,10 +227,22 @@ def main(argv=None) -> int:
     ap.add_argument("--max-rewinds", type=int, default=3)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--ckpt-dir", default=None)
-    ap.add_argument("--out", default="INCIDENT_chaos_run.json")
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default INCIDENT_chaos_run.json,"
+                         " or TRAINFLEET_r01.json under --fleet)")
     ap.add_argument("--overhead", action="store_true",
                     help="also measure the wrapper's normal-path overhead")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the multi-process elastic-fleet drill "
+                         "(tools/train_fleet.py) instead of the "
+                         "in-process loop; requires one rank_kill fault")
     args = ap.parse_args(argv)
+    if args.out is None:
+        args.out = "TRAINFLEET_r01.json" if args.fleet \
+            else "INCIDENT_chaos_run.json"
+
+    if args.fleet:
+        return _run_fleet_lane(args)
 
     from apex_tpu.resilience import (DivergenceError, DurableCheckpointManager,
                                      FaultInjector, ResilienceConfig,

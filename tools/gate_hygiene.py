@@ -112,6 +112,15 @@ This check fails (exit 1) when
   don't add up to, or a typed-in "ok" is CONTRADICTORY and
   schema-invalid) — the KV-dedup A/B and its bitwise drill are gate
   memory like every other floor, or
+- a committed ``TRAINFLEET_r*.json`` does not validate against the
+  elastic-training-fleet schema (``apex_tpu/analysis/trainfleet.py``:
+  generation chain whose member sets strictly shrink/regrow, recovery
+  rows whose ``steps_lost`` re-derive from the kill/restore steps and
+  stay within one checkpoint interval, bitwise verdicts that re-derive
+  from the recorded state digests, and a ``gate`` agreeing with its
+  own bitwise table — a typed-in "survived the kill" is CONTRADICTORY
+  and schema-invalid) — the chaos drill's shrink/regrow evidence is
+  gate memory like every other floor, or
 - a committed ``TIMELINE_r*.json`` does not validate against the
   timeline schema (``apex_tpu/analysis/timeline.py``: every
   regression row must cite a series whose recorded points actually
@@ -158,7 +167,7 @@ PATTERNS = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json",
             "SERVE_DISAGG_r*.json", "SCENARIO_r*.json",
             "TRACE_r*.json", "TIMELINE_r*.json",
             "PROFILE_DRIFT_r*.json", "FLEETLINT_r*.json",
-            "PREFIXCACHE_r*.json")
+            "PREFIXCACHE_r*.json", "TRAINFLEET_r*.json")
 
 #: Round-numbered incident artifacts additionally get schema-validated.
 INCIDENT_PATTERN = "INCIDENT_r*.json"
@@ -206,8 +215,11 @@ PROFILE_DRIFT_PATTERN = "PROFILE_DRIFT_r*.json"
 #: ... and the cross-rank SPMD consistency artifacts ...
 FLEETLINT_PATTERN = "FLEETLINT_r*.json"
 
-#: ... and the cross-request prefix-sharing gate artifacts.
+#: ... and the cross-request prefix-sharing gate artifacts ...
 PREFIXCACHE_PATTERN = "PREFIXCACHE_r*.json"
+
+#: ... and the elastic-training-fleet chaos-drill artifacts.
+TRAINFLEET_PATTERN = "TRAINFLEET_r*.json"
 
 
 def _load_by_path(repo: str, *rel: str):
@@ -464,6 +476,22 @@ def _validate_prefixcaches(repo: str) -> "list[str]":
     return problems
 
 
+def _validate_trainfleets(repo: str) -> "list[str]":
+    """Schema problems over every present TRAINFLEET_r*.json, as
+    ``path: problem`` strings (``apex_tpu/analysis/trainfleet.py`` —
+    which also re-derives the bitwise verdicts, the generation chain,
+    and the steps-lost bound from the recorded events and digests)."""
+    schema = _load_by_path(repo, "apex_tpu", "analysis",
+                           "trainfleet.py")
+    if schema is None:
+        return []
+    problems = []
+    for p in sorted(Path(repo).glob(TRAINFLEET_PATTERN)):
+        for msg in schema.validate_trainfleet_file(str(p)):
+            problems.append(f"{p.name}: {msg}")
+    return problems
+
+
 def _git(repo: str, *args: str) -> "str | None":
     """stdout of a git command, or None when git/The repo is unavailable
     (the best-effort contract)."""
@@ -495,7 +523,7 @@ def check(repo: str = str(REPO)) -> dict:
                 "invalid_scenarios": [], "invalid_traces": [],
                 "invalid_variances": [], "invalid_timelines": [],
                 "invalid_profile_drifts": [], "invalid_fleetlints": [],
-                "invalid_prefixcaches": []}
+                "invalid_prefixcaches": [], "invalid_trainfleets": []}
     tracked = set(tracked_raw.split())
     missing = [f for f in REQUIRED
                if not (Path(repo) / f).exists() or f not in tracked]
@@ -531,13 +559,15 @@ def check(repo: str = str(REPO)) -> dict:
     invalid_pd = _validate_profile_drifts(repo)
     invalid_fl = _validate_fleetlints(repo)
     invalid_pc = _validate_prefixcaches(repo)
+    invalid_tf = _validate_trainfleets(repo)
     return {"ok": not (missing or untracked or dirty or invalid
                        or invalid_mem or invalid_prec or invalid_dec
                        or invalid_obs or invalid_prof or invalid_conv
                        or invalid_exp or invalid_disagg
                        or invalid_scen or invalid_trace
                        or invalid_var or invalid_tl
-                       or invalid_pd or invalid_fl or invalid_pc),
+                       or invalid_pd or invalid_fl or invalid_pc
+                       or invalid_tf),
             "missing": missing, "untracked": untracked, "dirty": dirty,
             "invalid_incidents": invalid,
             "invalid_memlints": invalid_mem,
@@ -554,7 +584,8 @@ def check(repo: str = str(REPO)) -> dict:
             "invalid_timelines": invalid_tl,
             "invalid_profile_drifts": invalid_pd,
             "invalid_fleetlints": invalid_fl,
-            "invalid_prefixcaches": invalid_pc}
+            "invalid_prefixcaches": invalid_pc,
+            "invalid_trainfleets": invalid_tf}
 
 
 def main(argv=None) -> int:
@@ -591,7 +622,9 @@ def main(argv=None) -> int:
               f"fleetlint records "
               f"{verdict.get('invalid_fleetlints', [])}; invalid "
               f"prefix-cache records "
-              f"{verdict.get('invalid_prefixcaches', [])}",
+              f"{verdict.get('invalid_prefixcaches', [])}; invalid "
+              f"train-fleet records "
+              f"{verdict.get('invalid_trainfleets', [])}",
               file=sys.stderr)
         return 1
     return 0

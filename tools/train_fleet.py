@@ -1,0 +1,445 @@
+"""Elastic-fleet chaos drill: kill a real rank mid-training, require
+bitwise recovery, emit the ``TRAINFLEET_r*.json`` gate artifact.
+
+The drill (all real processes, CPU + gloo collectives):
+
+1. launch a 2-rank fleet of per-rank supervisors
+   (``python -m apex_tpu.resilience.fleet --role supervisor``), each of
+   which spawns a generation child running DDP + amp-O2 training under
+   :func:`apex_tpu.resilience.run_resilient`;
+2. a scheduled :class:`~apex_tpu.resilience.faults.RankKill` SIGKILLs
+   one rank (child AND supervisor — the heartbeat lease must actually
+   go stale) mid-training;
+3. the survivor detects the stale lease within the bounded window,
+   ends its generation, re-plans onto the smaller mesh, restores the
+   last durable step and continues;
+4. once the shrunken generation has committed a snapshot of its own,
+   the harness relaunches the killed rank's supervisor; its fresh
+   lease is the regrow signal — the fleet re-plans back to full size
+   and runs to completion.
+
+The artifact's verdicts are **re-derivable**: bitwise claims are made
+by *replaying* the post-restore schedules through the SAME child code
+path (fresh ledger, synthetic plan, the drill's own seed snapshot) and
+comparing sha256 state digests —
+
+- **shrink bitwise**: an uninterrupted 1-rank run of the post-kill
+  schedule (restore step → the shrunken generation's last durable
+  step) must digest-match the drill's own snapshot at that step;
+- **regrow bitwise**: an uninterrupted 2-rank run of the post-regrow
+  schedule must digest-match the drill's finals on every rank;
+- **cross-rank bitwise**: the drill's two final digests must agree.
+
+``apex_tpu/analysis/trainfleet.py`` validates the committed artifact
+and REJECTS contradictions: every stored verdict (steps-lost bound,
+bitwise flags, gate.ok) is recomputed from the recorded event log and
+digests, and a mismatch fails tier-1 via ``tools/gate_hygiene.py``.
+
+Usage::
+
+    python tools/train_fleet.py --out TRAINFLEET_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from apex_tpu.resilience.fleet import (  # noqa: E402
+    EXIT_MEMBERSHIP, FleetConfig, FleetLedger, latest_verified_step,
+    snapshot_digest)
+
+#: signal-death codes the harness expects from the killed rank's
+#: supervisor (negative = POSIX signal via subprocess)
+_KILLED = (-9,)
+
+
+class DrillError(RuntimeError):
+    pass
+
+
+def _env() -> dict:
+    env = dict(os.environ)
+    # the drill forms its own process mesh: the single-process test
+    # launcher's virtual-device flags and any ambient cluster config
+    # must not leak into supervisors or their children
+    for var in ("XLA_FLAGS", "COORDINATOR_ADDRESS", "WORLD_SIZE", "RANK"):
+        env.pop(var, None)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _launch_supervisor(root: str, rank: int) -> subprocess.Popen:
+    log = open(os.path.join(root, "logs", f"supervisor_r{rank}.log"), "w")
+    try:
+        return subprocess.Popen(
+            [sys.executable, "-m", "apex_tpu.resilience.fleet",
+             "--role", "supervisor", "--ledger", root,
+             "--rank", str(rank)],
+            stdout=log, stderr=subprocess.STDOUT, env=_env())
+    finally:
+        log.close()     # the child holds its own fd
+
+
+def _wait_for(pred, timeout_s: float, what: str, poll_s: float = 0.1):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        val = pred()
+        if val:
+            return val
+    raise DrillError(f"timed out after {timeout_s:g}s waiting for {what}")
+
+
+def _drain(procs: Dict[int, subprocess.Popen], timeout_s: float,
+           what: str) -> Dict[int, int]:
+    deadline = time.monotonic() + timeout_s
+    codes: Dict[int, int] = {}
+    while len(codes) < len(procs):
+        for r, p in procs.items():
+            if r not in codes and p.poll() is not None:
+                codes[r] = p.returncode
+        if time.monotonic() > deadline:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+            raise DrillError(
+                f"timed out after {timeout_s:g}s waiting for {what} "
+                f"(codes so far: {codes})")
+        time.sleep(0.1)
+    return codes
+
+
+def _seed_replay_root(tag: str, base: str, drill_ckpt: str,
+                      seed_step: int) -> str:
+    """A fresh ledger root whose ckpt/ holds EXACTLY the drill's
+    snapshot at ``seed_step`` — so the replay supervisors' initial
+    plan restores that step and nothing else."""
+    from apex_tpu.resilience.durable import _step_dirname
+    root = os.path.join(base, f"replay_{tag}")
+    ledger = FleetLedger(root)     # creates the layout
+    src = os.path.join(drill_ckpt, _step_dirname(seed_step))
+    if not os.path.isdir(src):
+        raise DrillError(f"replay {tag}: drill has no snapshot at step "
+                         f"{seed_step} to seed from")
+    shutil.copytree(src, os.path.join(ledger.ckpt_dir,
+                                      _step_dirname(seed_step)))
+    return root
+
+
+def _run_replay(tag: str, base: str, drill_cfg: FleetConfig,
+                drill_ckpt: str, seed_step: int, world: int,
+                num_steps: int, timeout_s: float) -> dict:
+    """Run an UNINTERRUPTED fleet of ``world`` ranks from the drill's
+    own snapshot at ``seed_step`` through ``num_steps`` total steps —
+    the same supervisor→child→``run_resilient`` path as the drill, with
+    no faults — and return its finals + event skeleton."""
+    root = _seed_replay_root(tag, base, drill_ckpt, seed_step)
+    ledger = FleetLedger(root)
+    # no faults, no pacing: the throttle is pure wall time (a host
+    # sleep in batch_fn), so dropping it cannot change the math the
+    # replay exists to reproduce bit-for-bit
+    cfg = dataclasses.replace(drill_cfg, world_size=world,
+                              num_steps=num_steps, faults=(),
+                              step_delay_s=0.0)
+    ledger.write_config(cfg)
+    procs = {r: _launch_supervisor(root, r) for r in range(world)}
+    codes = _drain(procs, timeout_s, f"replay {tag} supervisors")
+    if any(c != 0 for c in codes.values()):
+        tails = {r: _log_tail(root, r) for r in codes}
+        raise DrillError(f"replay {tag}: supervisor exit codes {codes}; "
+                         f"log tails: {tails}")
+    finals = ledger.finals()
+    if sorted(finals) != list(range(world)):
+        raise DrillError(f"replay {tag}: finals missing ranks "
+                         f"(got {sorted(finals)})")
+    plan0 = ledger.read_plan(0)
+    return {
+        "tag": tag, "world": world, "restore_step": seed_step,
+        "final_step": num_steps - 1,
+        "finals": {str(r): {"step": f["step"], "digest": f["digest"]}
+                   for r, f in finals.items()},
+        "plan_restore_step": plan0.get("restore_step") if plan0 else None,
+        "root": root,
+    }
+
+
+def _log_tail(root: str, rank: int, limit: int = 800) -> str:
+    try:
+        with open(os.path.join(root, "logs",
+                               f"supervisor_r{rank}.log"),
+                  errors="replace") as f:
+            return f.read()[-limit:]
+    except OSError:
+        return "<no log>"
+
+
+def run_drill(args) -> dict:
+    base = args.root or tempfile.mkdtemp(prefix="apex_tpu_fleet_")
+    root = os.path.join(base, "drill")
+    ledger = FleetLedger(root)
+    cfg = FleetConfig(
+        num_steps=args.steps, checkpoint_every=args.checkpoint_every,
+        world_size=2, seed=args.seed,
+        lease_ttl_s=args.lease_ttl, heartbeat_s=args.heartbeat,
+        step_delay_s=args.step_delay,
+        faults=(f"rank_kill@{args.kill_step}:{args.kill_rank}",))
+    ledger.write_config(cfg)
+    t_start = time.time()
+
+    procs = {r: _launch_supervisor(root, r) for r in range(2)}
+    try:
+        # 1. the kill: the doomed rank writes its forensic event and
+        #    SIGKILLs child + supervisor
+        _wait_for(lambda: [e for e in ledger.events()
+                           if e["kind"] == "kill"],
+                  args.timeout, "the scheduled rank kill")
+        _wait_for(lambda: procs[args.kill_rank].poll() is not None,
+                  30.0, "the killed supervisor to die")
+        kill_code = procs[args.kill_rank].returncode
+        if kill_code not in _KILLED:
+            raise DrillError(f"killed rank's supervisor exited {kill_code},"
+                             " expected SIGKILL death")
+
+        # 2. shrink: the survivor replans (gen >= 1) and the shrunken
+        #    generation commits durable progress of its own — only then
+        #    is the regrow bitwise gate non-trivial
+        def _shrunk():
+            if ledger.finals():
+                raise DrillError(
+                    "the shrunken generation finished before the killed "
+                    "rank could be relaunched — raise --step-delay so a "
+                    "generation outlives the rejoin latency")
+            plan = ledger.latest_plan()
+            if plan is None or int(plan["gen"]) < 1:
+                return None
+            restore = plan.get("restore_step")
+            latest = latest_verified_step(ledger.ckpt_dir)
+            if latest is None or restore is None:
+                return None
+            return plan if latest > int(restore) else None
+
+        plan1 = _wait_for(_shrunk, args.timeout,
+                          "the shrunken generation to commit a snapshot")
+
+        # 3. regrow: relaunch the killed rank's supervisor; its fresh
+        #    heartbeat is the regrow signal
+        procs[args.kill_rank] = _launch_supervisor(root, args.kill_rank)
+        codes = _drain(procs, args.timeout, "the regrown fleet to finish")
+        if any(c != 0 for c in codes.values()):
+            tails = {r: _log_tail(root, r) for r in codes}
+            raise DrillError(f"supervisor exit codes {codes}; "
+                             f"log tails: {tails}")
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    wall_s = time.time() - t_start
+    events = ledger.events()
+    finals = ledger.finals()
+    plans = []
+    g = 0
+    while True:
+        plan = ledger.read_plan(g)
+        if plan is None:
+            break
+        plans.append(plan)
+        g += 1
+    if len(plans) < 3:
+        raise DrillError(f"expected >= 3 generations (initial/shrink/"
+                         f"regrow), got {len(plans)}")
+    if sorted(finals) != [0, 1]:
+        raise DrillError(f"finals missing ranks (got {sorted(finals)})")
+
+    kill_events = [e for e in events if e["kind"] == "kill"]
+    snapshots = {}
+    from apex_tpu.resilience.durable import _STEP_PREFIX
+    for name in sorted(os.listdir(ledger.ckpt_dir)):
+        if name.startswith(_STEP_PREFIX):
+            step = int(name[len(_STEP_PREFIX):])
+            snapshots[str(step)] = snapshot_digest(ledger.ckpt_dir, step)
+
+    incidents = []
+    inc_dir = ledger.path("incidents")
+    for name in sorted(os.listdir(inc_dir)):
+        if name.endswith(".json"):
+            with open(os.path.join(inc_dir, name)) as f:
+                incidents.append(json.load(f))
+
+    return {
+        "base": base, "root": root, "cfg": cfg, "wall_s": wall_s,
+        "events": events, "finals": finals, "plans": plans,
+        "kill_events": kill_events, "snapshots": snapshots,
+        "incidents": incidents, "plan1": plan1,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--steps", type=int, default=24)
+    ap.add_argument("--checkpoint-every", type=int, default=4)
+    ap.add_argument("--kill-step", type=int, default=10)
+    ap.add_argument("--kill-rank", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--lease-ttl", type=float, default=2.0)
+    ap.add_argument("--heartbeat", type=float, default=0.25)
+    ap.add_argument("--step-delay", type=float, default=0.75,
+                    help="host sleep per drill step: paces the toy CPU "
+                    "workload so a relaunched rank can rejoin a LIVE "
+                    "generation (replays run unthrottled)")
+    ap.add_argument("--timeout", type=float, default=300.0,
+                    help="per-phase wall budget (kill/shrink/finish)")
+    ap.add_argument("--round", type=int, default=1)
+    ap.add_argument("--root", default=None,
+                    help="working dir (default: fresh tempdir)")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the working dir for forensics")
+    ap.add_argument("--out", default="TRAINFLEET_r01.json")
+    args = ap.parse_args(argv)
+
+    from apex_tpu.analysis.trainfleet import validate_trainfleet
+    from apex_tpu.resilience.incidents import utc_now
+
+    drill = run_drill(args)
+    cfg: FleetConfig = drill["cfg"]
+    plans = drill["plans"]
+    plan1, plan2 = plans[1], plans[2]
+    s1 = int(plan1["restore_step"])      # shrink restore (pre-kill)
+    s2 = int(plan2["restore_step"])      # regrow restore (gen-1 progress)
+    kill_step = int(drill["kill_events"][0]["step"])
+
+    # -- the replay-based bitwise gates ---------------------------------
+    replay_shrink = _run_replay(
+        "shrink", drill["base"], cfg, FleetLedger(drill["root"]).ckpt_dir,
+        seed_step=s1, world=len(plan1["members"]), num_steps=s2 + 1,
+        timeout_s=args.timeout)
+    replay_regrow = _run_replay(
+        "regrow", drill["base"], cfg, FleetLedger(drill["root"]).ckpt_dir,
+        seed_step=s2, world=len(plan2["members"]), num_steps=cfg.num_steps,
+        timeout_s=args.timeout)
+
+    finals = {str(r): {"step": f["step"], "digest": f["digest"]}
+              for r, f in drill["finals"].items()}
+    shrink_digest = replay_shrink["finals"]["0"]["digest"]
+    bitwise = {
+        # uninterrupted 1-rank replay of the post-kill schedule lands
+        # bit-identical to the drill's own durable snapshot at s2
+        "shrink_matches_uninterrupted":
+            shrink_digest == drill["snapshots"].get(str(s2)),
+        # uninterrupted 2-rank replay of the post-regrow schedule lands
+        # bit-identical to the drill's finals, rank by rank
+        "regrow_matches_uninterrupted": all(
+            replay_regrow["finals"][r]["digest"] == finals[r]["digest"]
+            for r in finals),
+        "final_cross_rank_identical":
+            len({f["digest"] for f in finals.values()}) == 1,
+    }
+
+    doc = {
+        "artifact": "TRAINFLEET",
+        "round": args.round,
+        "generated_utc": utc_now(),
+        "platform": "cpu",
+        "harness": "tools/train_fleet.py -> apex_tpu.resilience.fleet",
+        "config": {
+            "num_steps": cfg.num_steps,
+            "checkpoint_every": cfg.checkpoint_every,
+            "world_size": cfg.world_size,
+            "seed": cfg.seed,
+            "lease_ttl_s": cfg.lease_ttl_s,
+            "heartbeat_s": cfg.heartbeat_s,
+            "faults": list(cfg.faults),
+        },
+        "wall_s": round(drill["wall_s"], 3),
+        "events": drill["events"],
+        "generations": [
+            {"gen": int(p["gen"]),
+             "members": [int(r) for r in p["members"]],
+             "restore_step": p.get("restore_step"),
+             "reason": p["reason"], "created_by": int(p["created_by"])}
+            for p in plans],
+        "recoveries": [
+            {"generation": int(plan1["gen"]),
+             "reason": "shrink",
+             "interrupted_step": kill_step,
+             "restore_step": s1,
+             "steps_lost": kill_step - s1,
+             "ranks": sorted(set([int(r) for r in plans[0]["members"]])
+                             - set([int(r) for r in plan1["members"]]))},
+            {"generation": int(plan2["gen"]),
+             "reason": "regrow",
+             "interrupted_step": None,
+             "restore_step": s2,
+             "steps_lost": 0,
+             "ranks": sorted(set([int(r) for r in plan2["members"]])
+                             - set([int(r) for r in plan1["members"]]))},
+        ],
+        "snapshots": drill["snapshots"],
+        "finals": finals,
+        "replays": {
+            "shrink": {k: replay_shrink[k] for k in
+                       ("world", "restore_step", "final_step", "finals")},
+            "regrow": {k: replay_regrow[k] for k in
+                       ("world", "restore_step", "final_step", "finals")},
+        },
+        "bitwise": bitwise,
+        "incidents": drill["incidents"],
+        "gate": {
+            "ok": all(bitwise.values()),
+            "criteria": [
+                "rank killed mid-training (real SIGKILL, supervisor too)",
+                "survivor shrank within the lease window and restored "
+                "the last durable step",
+                "steps lost <= checkpoint interval",
+                "shrunken run bitwise-equal to uninterrupted same-"
+                "schedule run",
+                "fleet regrew on rank return and finished bitwise-"
+                "identical on every rank",
+            ],
+        },
+    }
+
+    problems = validate_trainfleet(doc)
+    if problems:
+        print(json.dumps({"ok": False, "problems": problems}, indent=1))
+        return 1
+
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, args.out)
+
+    if not args.keep and args.root is None:
+        shutil.rmtree(drill["base"], ignore_errors=True)
+
+    print(json.dumps({
+        "ok": doc["gate"]["ok"], "out": args.out,
+        "wall_s": doc["wall_s"],
+        "kill_step": kill_step, "shrink_restore": s1,
+        "regrow_restore": s2,
+        "steps_lost": kill_step - s1,
+        "generations": len(plans), "bitwise": bitwise,
+    }))
+    return 0 if doc["gate"]["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
