@@ -850,6 +850,16 @@ def _varying(x) -> bool:
     try:
         return bool(jax.typeof(x).vma)
     except Exception:
+        pass
+    # pre-VMA jax has no varying type to ask; any active mapped axis
+    # (legacy shard_map / pmap trace) means x MAY be device-varying,
+    # which is the same "don't run the pallas interpreter" situation
+    # the VMA check routes around (and legacy check_rep has no
+    # replication rule for pallas_call at all)
+    try:
+        from jax._src.core import get_axis_env
+        return bool(get_axis_env().axis_sizes)
+    except Exception:
         return False
 
 

@@ -353,10 +353,17 @@ def spawn(argslist: Sequence[str], world_size: Optional[int] = None,
     never complete — the all-zero twin of the crash case above) is
     bounded by a grace window instead of hanging the launcher forever.
     Once the first rank exits 0, the stragglers get
-    ``APEX_TPU_SPAWN_GRACE_S`` seconds (default 60) to follow; then
-    they are terminated (SIGTERM, 5s, SIGKILL), and spawn raises a
-    :class:`ClusterInitError` naming the wedged ranks — within the
-    watchdog budget, not past test teardown.
+    ``max(APEX_TPU_SPAWN_GRACE_S, elapsed runtime so far)`` seconds to
+    follow (env default 60 — the scaling gives a workload that ran for
+    minutes a proportional allowance for legitimately skewed per-rank
+    epilogues); then they are terminated (SIGTERM, 5s, SIGKILL), and
+    spawn raises a :class:`ClusterInitError` naming the wedged ranks —
+    within the watchdog budget, not past test teardown.  **Caller
+    contract change vs the pre-reaping spawn:** ranks that
+    legitimately finish further apart than the scaled window are now
+    reaped and reported as wedged; such callers must raise
+    ``APEX_TPU_SPAWN_GRACE_S``, or set it ``<= 0`` to disable reaping
+    entirely (restoring the old wait-forever behavior).
     """
     argslist = list(argslist)
     if world_size is None:
@@ -407,17 +414,24 @@ def spawn(argslist: Sequence[str], world_size: Optional[int] = None,
         # waiting for it — fail fast and tear the others down instead.
         import time
         grace_s = float(os.environ.get("APEX_TPU_SPAWN_GRACE_S", "60"))
+        launch_t = time.monotonic()
         first_done: Optional[float] = None
+        grace_eff = grace_s
         while True:
             codes = [p.poll() for p in workers]
             if all(c is not None for c in codes):
                 if any(c != 0 for c in codes):
                     _raise_first_failure(codes)
                 return codes
-            if any(c == 0 for c in codes):
+            if grace_s > 0 and any(c == 0 for c in codes):
                 if first_done is None:
                     first_done = time.monotonic()
-                elif time.monotonic() - first_done > grace_s:
+                    # skew allowance scales with observed runtime: a
+                    # workload that ran for minutes may legitimately
+                    # drain its per-rank epilogues minutes apart, while
+                    # a quick run's zombie is still reaped at the base
+                    grace_eff = max(grace_s, first_done - launch_t)
+                elif time.monotonic() - first_done > grace_eff:
                     # zombie peers: their partner is gone, the pending
                     # collective can never complete — reap, don't hang
                     wedged = [i for i, c in enumerate(codes) if c is None]
@@ -431,7 +445,7 @@ def spawn(argslist: Sequence[str], world_size: Optional[int] = None,
                             p.kill()
                             p.wait()
                     raise ClusterInitError(
-                        f"ranks {wedged} still running {grace_s:g}s after "
+                        f"ranks {wedged} still running {grace_eff:g}s after "
                         f"rank {codes.index(0)} exited cleanly (exit codes "
                         f"{codes}): wedged in a collective whose peer is "
                         f"gone; terminated.  rank {wedged[0]} stderr tail "

@@ -30,7 +30,10 @@ cache, the flight recorder — and this module composes them into an
   immutable plan (``gen/gen_NNNN.json``: members, coordinator port,
   restore step).  A membership change ends the generation: every
   surviving child exits with :data:`EXIT_MEMBERSHIP`, the per-rank
-  supervisor re-elects a leader (min live rank), the leader writes the
+  supervisor re-elects a leader (min *surviving member* — a freshly
+  returned rank waits as a joiner and never leads a replan, so a
+  regrow cannot deadlock on the smallest rank's return; a joiner takes
+  over only when every member's lease is stale), the leader writes the
   next plan (O_EXCL create — exactly one wins), and each supervisor
   spawns a fresh child that re-forms the cluster via
   :func:`multiproc.initialize` (bounded retry), re-runs the SPMD
@@ -804,60 +807,98 @@ def run_generation(ledger: FleetLedger, cfg: FleetConfig, gen: int,
         incidents_lib.write_incident(path, status, summary, evidence,
                                      gen=gen, rank=rank, **extra)
 
+    def _on_change(reason: str, ranks: Sequence[int],
+                   abs_step: int) -> None:
+        if reason == "shrink":
+            fr.note("kill", ranks=list(ranks), step=abs_step)
+        fr.note(f"{reason}_detected", ranks=list(ranks), step=abs_step)
+
+    def _classified_end(e: BaseException) -> Optional[int]:
+        """Route a failure through the lease check: a stale peer lease
+        means the failure IS a membership change (return
+        EXIT_MEMBERSHIP via the common epilogue); ``None`` means a
+        genuine program error the caller must re-raise."""
+        change = _classify_failure(ledger, cfg, plan, rank, e,
+                                   step_cell["step"])
+        if change is None:
+            ledger.event(rank, "child_error", gen=gen,
+                         phase=step_cell["phase"],
+                         error=f"{type(e).__name__}: {e}"[:500])
+            return None
+        _on_change(change.reason, change.ranks, change.step)
+        return _end_generation(ledger, cfg, fm, fr, _incident, gen,
+                               rank, world, members, change,
+                               cause=repr(e)[:300])
+
     manager = None
     try:
-        step_cell["phase"] = "cluster_init"
-        multiproc.initialize(
-            coordinator_address=f"localhost:{plan['port']}",
-            num_processes=world, process_id=idx,
-            timeout_s=cfg.init_timeout_s, retries=cfg.init_retries)
-        wl = _Workload(cfg, world, idx)
-
-        step_cell["phase"] = "preflight"
-        pre = multiproc.spmd_preflight(wl.lower(),
-                                       label=f"fleet_gen{gen}")
-        ledger.event(rank, "preflight", gen=gen, ok=bool(pre["ok"]),
-                     n_collectives=pre["n_collectives"],
-                     schedule_hash=pre["schedule_hash"])
-        fr.note("preflight", gen=gen, n_collectives=pre["n_collectives"])
-
-        step_cell["phase"] = "aot"
-        state_g0 = wl.to_global(wl.local_template)
         try:
-            compiled, ainfo = export_mod.probe(
-                wl.jit_fn, state_g0, wl.make_global_batch(start),
-                cache_dir=ledger.aot_dir, lane=f"world{world}",
-                export_on_miss=True)
-            step_fn = lambda s, xb: compiled(s, xb)   # noqa: E731
-            aot_source = ainfo["source"]
-        except Exception as e:  # noqa: BLE001 - cache is an optimization
-            step_fn = wl.jit_fn
-            aot_source = f"disabled: {type(e).__name__}"
-        ledger.event(rank, "aot", gen=gen, source=aot_source, world=world)
-        fr.note("aot", gen=gen, source=aot_source)
+            step_cell["phase"] = "cluster_init"
+            multiproc.initialize(
+                coordinator_address=f"localhost:{plan['port']}",
+                num_processes=world, process_id=idx,
+                timeout_s=cfg.init_timeout_s, retries=cfg.init_retries)
+            wl = _Workload(cfg, world, idx)
 
-        step_cell["phase"] = "restore"
-        if restore_step is not None:
-            state_local, _extras = load_snapshot_state(
-                ledger.ckpt_dir, int(restore_step), wl.local_template)
-            digest = snapshot_digest(ledger.ckpt_dir, int(restore_step))
-            state_g = wl.to_global(state_local)
-            ledger.event(rank, "restore", gen=gen, step=int(restore_step),
-                         digest=digest)
-            fr.note("restore", gen=gen, step=int(restore_step))
-            if gen > 0:
-                fm.on_recovery(max(0.0, time.time()
-                                   - float(plan.get("created_ts", 0.0))))
-                _incident(
-                    "fleet-restored",
-                    f"generation {gen} (world {world}) resumed from "
-                    f"durable step {restore_step}",
-                    [f"restored step {restore_step} digest "
-                     f"{digest[:16]}…",
-                     f"members {members}", f"aot source {aot_source}"],
-                    restore_step=int(restore_step))
-        else:
-            state_g = state_g0
+            step_cell["phase"] = "preflight"
+            pre = multiproc.spmd_preflight(wl.lower(),
+                                           label=f"fleet_gen{gen}")
+            ledger.event(rank, "preflight", gen=gen, ok=bool(pre["ok"]),
+                         n_collectives=pre["n_collectives"],
+                         schedule_hash=pre["schedule_hash"])
+            fr.note("preflight", gen=gen,
+                    n_collectives=pre["n_collectives"])
+
+            step_cell["phase"] = "aot"
+            state_g0 = wl.to_global(wl.local_template)
+            try:
+                compiled, ainfo = export_mod.probe(
+                    wl.jit_fn, state_g0, wl.make_global_batch(start),
+                    cache_dir=ledger.aot_dir, lane=f"world{world}",
+                    export_on_miss=True)
+                step_fn = lambda s, xb: compiled(s, xb)   # noqa: E731
+                aot_source = ainfo["source"]
+            except Exception as e:  # noqa: BLE001 - cache is optional
+                step_fn = wl.jit_fn
+                aot_source = f"disabled: {type(e).__name__}"
+            ledger.event(rank, "aot", gen=gen, source=aot_source,
+                         world=world)
+            fr.note("aot", gen=gen, source=aot_source)
+
+            step_cell["phase"] = "restore"
+            if restore_step is not None:
+                state_local, _extras = load_snapshot_state(
+                    ledger.ckpt_dir, int(restore_step), wl.local_template)
+                digest = snapshot_digest(ledger.ckpt_dir,
+                                         int(restore_step))
+                state_g = wl.to_global(state_local)
+                ledger.event(rank, "restore", gen=gen,
+                             step=int(restore_step), digest=digest)
+                fr.note("restore", gen=gen, step=int(restore_step))
+                if gen > 0:
+                    fm.on_recovery(max(
+                        0.0, time.time()
+                        - float(plan.get("created_ts", 0.0))))
+                    _incident(
+                        "fleet-restored",
+                        f"generation {gen} (world {world}) resumed from "
+                        f"durable step {restore_step}",
+                        [f"restored step {restore_step} digest "
+                         f"{digest[:16]}…",
+                         f"members {members}",
+                         f"aot source {aot_source}"],
+                        restore_step=int(restore_step))
+            else:
+                state_g = state_g0
+        except Exception as e:  # noqa: BLE001 - classify via the lease
+            # a peer dying during FORMATION (init timeout, preflight
+            # barrier, restore) must end in a replan like a mid-step
+            # death — letting it propagate exits every survivor fatal,
+            # stops their leases, and cascades to total fleet death
+            code = _classified_end(e)
+            if code is None:
+                raise
+            return code
 
         remaining = cfg.num_steps - start
         if remaining <= 0:
@@ -870,12 +911,6 @@ def run_generation(ledger: FleetLedger, cfg: FleetConfig, gen: int,
             manager = _StepOffsetManager(
                 DurableCheckpointManager(ledger.ckpt_dir,
                                          max_to_keep=10_000), start)
-
-        def _on_change(reason: str, ranks: Sequence[int],
-                       abs_step: int) -> None:
-            if reason == "shrink":
-                fr.note("kill", ranks=list(ranks), step=abs_step)
-            fr.note(f"{reason}_detected", ranks=list(ranks), step=abs_step)
 
         gate = membership_gate(ledger, cfg, plan, rank,
                                on_change=_on_change)
@@ -917,16 +952,10 @@ def run_generation(ledger: FleetLedger, cfg: FleetConfig, gen: int,
             return _end_generation(ledger, cfg, fm, fr, _incident, gen,
                                    rank, world, members, e)
         except Exception as e:  # noqa: BLE001 - classify via the lease
-            change = _classify_failure(ledger, cfg, plan, rank, e,
-                                       step_cell["step"])
-            if change is None:
-                ledger.event(rank, "child_error", gen=gen,
-                             error=f"{type(e).__name__}: {e}"[:500])
+            code = _classified_end(e)
+            if code is None:
                 raise
-            _on_change(change.reason, change.ranks, change.step)
-            return _end_generation(ledger, cfg, fm, fr, _incident, gen,
-                                   rank, world, members, change,
-                                   cause=repr(e)[:300])
+            return code
 
         state_local = wl.to_local(result.state)
         final_digest = state_digest(state_local)
@@ -952,12 +981,14 @@ def run_generation(ledger: FleetLedger, cfg: FleetConfig, gen: int,
 
 
 def _classify_failure(ledger: FleetLedger, cfg: FleetConfig, plan: dict,
-                      rank: int, exc: BaseException, abs_step: int
-                      ) -> Optional[FleetMembershipChange]:
-    """A step that blew up mid-generation is a *shrink* iff a peer's
-    lease is (or within one TTL becomes) stale — the gloo peer-close
-    error races the lease file, so wait out one TTL before deciding it
-    was a genuine program error."""
+                      rank: int, exc: Optional[BaseException],
+                      abs_step: int) -> Optional[FleetMembershipChange]:
+    """A failure mid-generation is a *shrink* iff a peer's lease is
+    (or within one TTL becomes) stale — the gloo peer-close error
+    races the lease file, so wait out one TTL before deciding it was a
+    genuine program error.  The evidence is the lease state, never the
+    exception text (``exc`` may be ``None``: the supervisor applies
+    the same test to a child that died too hard to raise at all)."""
     peers = [int(r) for r in plan["members"] if int(r) != rank]
     deadline = time.monotonic() + cfg.lease_ttl_s + 3 * cfg.heartbeat_s
     while time.monotonic() < deadline:
@@ -997,6 +1028,47 @@ def _end_generation(ledger: FleetLedger, cfg: FleetConfig,
              evidence, step=change.step, ranks=change.ranks,
              restore_candidate=candidate)
     return EXIT_MEMBERSHIP
+
+
+def _record_reclassified_death(ledger: FleetLedger, gen: int, rank: int,
+                               code: int,
+                               change: FleetMembershipChange) -> None:
+    """The child died too hard to record its own membership-change
+    trace (jax's distributed client ``LOG(FATAL)``\\ s the process when
+    a peer vanishes during formation or takes the coordination service
+    with it), so the supervisor emits the same canonical events and
+    incident the child's :func:`_end_generation` would have — auditors
+    (the ``TRAINFLEET`` schema, the drill gate) must see one
+    vocabulary regardless of which side detected the change."""
+    from apex_tpu.obs.flight import FlightRecorder
+    from apex_tpu.resilience import incidents as incidents_lib
+    ledger.event(rank, "child_death_reclassified", gen=gen, code=code,
+                 reason=change.reason, ranks=change.ranks,
+                 step=change.step)
+    candidate = latest_verified_step(ledger.ckpt_dir)
+    ledger.event(rank, f"{change.reason}_detected", gen=gen,
+                 step=change.step, ranks=change.ranks,
+                 restore_candidate=candidate, via="supervisor")
+    fr = FlightRecorder()
+    if change.reason == "shrink":
+        fr.note("kill", ranks=list(change.ranks), step=change.step)
+    fr.note(f"{change.reason}_detected", ranks=list(change.ranks),
+            step=change.step)
+    status = {"shrink": "fleet-shrink", "regrow": "fleet-regrow"}.get(
+        change.reason, "fleet-replan")
+    incidents_lib.write_incident(
+        ledger.path("incidents",
+                    f"gen{gen}_rank{rank}_{status}_supervisor.json"),
+        status,
+        f"generation {gen} ended at step {change.step}: {change.reason} "
+        f"of ranks {change.ranks} (child died hard, exit {code})",
+        [f"child exit code {code}: classified via peer leases — the "
+         f"child never raised, its own recorder died with it",
+         f"membership change at step {change.step}: {change.reason} "
+         f"(ranks {change.ranks})",
+         f"latest verified durable step: {candidate}"],
+        gen=gen, rank=rank, step=change.step, ranks=change.ranks,
+        restore_candidate=candidate, flight=fr.dump())
 
 
 # ---------------------------------------------------------------------------
@@ -1075,6 +1147,8 @@ def supervise(root: str, rank: int,
                            info_fn=lambda: {"incarnation": inc}).start()
     try:
         form_deadline = time.monotonic() + cfg.form_window_s
+        join_gen: Optional[int] = None     # generation we wait on as a
+        join_t0 = 0.0                      # non-member, and since when
         while True:
             plan = ledger.latest_plan()
             if plan is None:
@@ -1101,6 +1175,18 @@ def supervise(root: str, rank: int,
                 if all(int(r) in finals for r in plan["members"]):
                     ledger.event(rank, "join_after_done", gen=gen)
                     return 0
+                if join_gen != gen:
+                    join_gen, join_t0 = gen, time.monotonic()
+                if _take_over_dead_generation(ledger, cfg, rank, plan):
+                    continue
+                # bounded: live members replan around a fresh joiner
+                # within lease_ttl + poll + replan_window — a joiner
+                # still planless past that is stuck, not patient
+                join_budget = cfg.form_window_s + cfg.replan_window_s
+                if time.monotonic() - join_t0 > join_budget:
+                    raise FleetError(
+                        f"rank {rank}: generation {gen} never replanned "
+                        f"around this joiner within {join_budget:g}s")
                 time.sleep(cfg.poll_s)
                 continue
             ledger.event(rank, "spawn_child", gen=gen)
@@ -1115,10 +1201,25 @@ def supervise(root: str, rank: int,
                 ledger.event(rank, "rank_done", gen=gen)
                 return 0
             if code != EXIT_MEMBERSHIP:
-                # fatal: stop heartbeating (via finally) so the fleet
-                # shrinks around this rank instead of waiting for it
-                ledger.event(rank, "rank_fatal", gen=gen, code=code)
-                return code if code > 0 else 1
+                # the child died HARD: jax's distributed client
+                # LOG(FATAL)s the process (SIGABRT) when a peer dies
+                # during cluster formation, so the child's own
+                # classifier never ran.  Apply the same lease test
+                # here: a stale peer means this death is a membership
+                # casualty and the rank REPLANS; only a peer-less
+                # death is fatal (stopping our lease via finally, so
+                # the fleet shrinks around this rank instead of
+                # cascading every survivor to rank_fatal)
+                pr = ledger.read_progress(rank) or {}
+                step = pr.get("step")
+                change = _classify_failure(
+                    ledger, cfg, plan, rank, None,
+                    step if isinstance(step, int) else -1)
+                if change is None:
+                    ledger.event(rank, "rank_fatal", gen=gen, code=code)
+                    return code if code > 0 else 1
+                _record_reclassified_death(ledger, gen, rank, code,
+                                           change)
             _await_next_plan(ledger, cfg, rank, gen)
     finally:
         lease.stop()
@@ -1163,33 +1264,70 @@ def _commit_plan(ledger: FleetLedger, cfg: FleetConfig, rank: int,
     return won
 
 
+def _replan_reason(old: set, new: set) -> str:
+    return ("regrow" if new > old else
+            "shrink" if new < old else "reform")
+
+
 def _await_next_plan(ledger: FleetLedger, cfg: FleetConfig, rank: int,
                      gen: int) -> dict:
-    """After EXIT_MEMBERSHIP: elect the next plan.  The min live rank
-    computes membership (live leases ∪ nobody else) and the restore
-    step (newest verifying snapshot) and commits gen+1; everyone else
-    polls for it.  Bounded by ``replan_window_s``."""
+    """After EXIT_MEMBERSHIP: elect the next plan.  The leader is the
+    minimum live rank AMONG THE ENDED GENERATION'S MEMBERS — only they
+    reach this replan loop; a rank that just returned sits in
+    ``supervise``'s joiner branch and never writes plans, so electing
+    the bare minimum live rank would deadlock the regrow exactly when
+    the returning rank has the smallest id (kill rank 0, not rank 1).
+    Membership is live leases ∪ nobody else, the restore step the
+    newest verifying snapshot.  If the elected member stalls, after
+    half the window every waiting member attempts the commit itself
+    (the O_EXCL create arbitrates: exactly one wins, losers adopt).
+    Bounded by ``replan_window_s``."""
     nxt = gen + 1
     prev = ledger.read_plan(gen) or {"members": []}
-    deadline = time.monotonic() + cfg.replan_window_s
+    prev_members = set(int(r) for r in prev["members"])
+    start = time.monotonic()
+    deadline = start + cfg.replan_window_s
+    grace = start + cfg.replan_window_s / 2.0
     while time.monotonic() < deadline:
         plan = ledger.read_plan(nxt)
         if plan is not None:
             return plan
         live = ledger.live_ranks(cfg.lease_ttl_s)
-        if live and min(live) == rank:
-            old = set(int(r) for r in prev["members"])
-            new = set(live)
-            reason = ("regrow" if new > old else
-                      "shrink" if new < old else "reform")
+        leaders = [r for r in live if r in prev_members]
+        if live and ((leaders and min(leaders) == rank)
+                     or time.monotonic() >= grace):
             restore = latest_verified_step(ledger.ckpt_dir)
             _commit_plan(ledger, cfg, rank, gen=nxt, members=live,
-                         restore_step=restore, reason=reason)
+                         restore_step=restore,
+                         reason=_replan_reason(prev_members, set(live)))
             continue
         time.sleep(cfg.poll_s)
     raise FleetError(
         f"rank {rank}: no generation {nxt} plan within "
         f"{cfg.replan_window_s}s of the membership change")
+
+
+def _take_over_dead_generation(ledger: FleetLedger, cfg: FleetConfig,
+                               rank: int, plan: dict) -> bool:
+    """A joiner waiting on a generation NONE of whose members is alive
+    (every lease stale — the whole previous fleet crashed fatally)
+    must not poll forever for a replan nobody is left to write: the
+    minimum live rank commits the next plan itself.  Racing a reviving
+    member is safe — the O_EXCL plan create arbitrates, and a loser
+    adopts the committed winner on its next poll."""
+    members = [int(r) for r in plan["members"]]
+    if any(ledger.fresh(r, cfg.lease_ttl_s) for r in members):
+        return False
+    live = ledger.live_ranks(cfg.lease_ttl_s)
+    if not live or min(live) != rank:
+        return False
+    nxt = int(plan["gen"]) + 1
+    ledger.event(rank, "takeover", gen=nxt, dead_members=members,
+                 members=live)
+    _commit_plan(ledger, cfg, rank, gen=nxt, members=live,
+                 restore_step=latest_verified_step(ledger.ckpt_dir),
+                 reason=_replan_reason(set(members), set(live)))
+    return True
 
 
 # ---------------------------------------------------------------------------

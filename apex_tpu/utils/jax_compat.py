@@ -14,7 +14,17 @@ open-coding try/except at every call site.
   gradients already materialize per-rank, which is exactly the state
   the tag requests, so identity preserves the semantics.
 - :func:`shard_map` — ``jax.shard_map``, else
-  ``jax.experimental.shard_map.shard_map``.
+  ``jax.experimental.shard_map.shard_map``.  The shim accepts a
+  ``check_rep`` kwarg everywhere: on legacy it passes through (legacy
+  default True — the checker's efficient-transpose rewrite is what
+  makes gradients wrt *replicated* inputs correct there, so it must
+  stay on by default); on the VMA API it is stripped (replication is
+  carried in types, the knob doesn't exist).  The few call sites whose
+  collective pattern the legacy checker cannot infer (it derives
+  variance from ``pvary`` annotations that are identity here) pass
+  ``check_rep=False`` explicitly — legal because they only
+  differentiate wrt *sharded* inputs, where the unrewritten psum
+  transpose is already correct.
 """
 
 from __future__ import annotations
@@ -34,8 +44,18 @@ def axis_size(axis_name):
 pvary = getattr(lax, "pvary", lambda x, axes: x)
 
 try:
-    shard_map = jax.shard_map
+    _shard_map_modern = jax.shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_rep=None, **kw):
+        del check_rep  # legacy-only knob; VMA types carry replication
+        return _shard_map_modern(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **kw)
 except AttributeError:
-    from jax.experimental.shard_map import shard_map  # noqa: F401
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_rep=True, **kw):
+        return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_rep,
+                                 **kw)
 
 __all__ = ["axis_size", "pvary", "shard_map"]

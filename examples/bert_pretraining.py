@@ -34,6 +34,7 @@ from apex_tpu.models.bert import (
 from apex_tpu.optimizers import fused_lamb
 from apex_tpu.parallel import DistributedDataParallel, data_parallel_mesh
 from apex_tpu.utils import maybe_print
+from apex_tpu.utils.jax_compat import shard_map
 
 # "large-tpu" = bert-large with the TPU-native 8x128 head geometry (same
 # parameter count, ~20% faster pretraining steps on v5e)
@@ -95,7 +96,7 @@ def main():
             s2, m = inner(s, *b)
             return s2, jax.lax.pmean(m["loss"], "data")
 
-        step = jax.jit(jax.shard_map(
+        step = jax.jit(shard_map(
             sharded, mesh=mesh,
             in_specs=(P(),) + (P("data"),) * 5, out_specs=(P(), P())))
     else:

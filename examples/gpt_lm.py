@@ -63,6 +63,7 @@ def main():
         ).strip()
     import dataclasses
     import jax
+    from apex_tpu.utils.jax_compat import shard_map
     if args.force_cpu:
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
@@ -127,11 +128,17 @@ def main():
             # each shard holds local_sum/global_count: psum = global mean
             return new_state, jax.lax.psum(metrics["loss"], "seq")
 
-        step = jax.jit(jax.shard_map(
+        # check_rep=False (legacy-jax only; stripped on the VMA API):
+        # the legacy checker can't see the seq-axis reductions through
+        # the ring-attention step (it infers replication from pvary
+        # annotations that are identity there) and rejects the
+        # replicated out_specs.  Safe: grad runs entirely inside the
+        # body with the loss normalizer/psum explicit (see lm_loss).
+        step = jax.jit(shard_map(
             train_step, mesh=mesh,
             in_specs=(P(), P(None, "seq"), P(None, "seq"),
                       P(None, "seq"), P(None, "seq")),
-            out_specs=(P(), P())))
+            out_specs=(P(), P()), check_rep=False))
         batch = (ids, targets, positions, mask)
     else:
         model = GPTModel(cfg)

@@ -47,6 +47,7 @@ from apex_tpu.parallel import (
     data_parallel_mesh,
 )
 from apex_tpu.utils import maybe_print
+from apex_tpu.utils.jax_compat import shard_map
 
 
 def parse_args():
@@ -357,7 +358,7 @@ def main():
             return (s2, stats2, jax.lax.pmean(m["loss"], "data"),
                     m["loss_scale"])
 
-        step = jax.jit(jax.shard_map(
+        step = jax.jit(shard_map(
             sharded, mesh=mesh,
             in_specs=(P(), P(), P("data"), P("data")),
             out_specs=(P(), P(), P(), P())))

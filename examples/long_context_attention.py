@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
+from apex_tpu.utils.jax_compat import shard_map
 
 
 def parse_args():
@@ -83,7 +84,7 @@ def main():
                for kk in jax.random.split(key, 3))
 
     fn = {"ring": ring_attention, "ulysses": ulysses_attention}[args.impl]
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(shard_map(
         lambda q, k, v: fn(q, k, v, "seq", causal=args.causal),
         mesh=mesh, in_specs=(P(None, "seq"),) * 3,
         out_specs=P(None, "seq")))

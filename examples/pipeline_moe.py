@@ -55,6 +55,7 @@ def main():
             + f" --xla_force_host_platform_device_count={args.devices}"
         ).strip()
     import jax
+    from apex_tpu.utils.jax_compat import shard_map
     if args.force_cpu:
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
@@ -124,7 +125,20 @@ def main():
         data_spec = P("expert")
 
     state = a.init(params)
-    train = amp.make_train_step(a, loss_fn, finite_axes=(axis,))
+    if args.mode == "ep":
+        # the replicated router's grads are cross-rank reduced
+        # EXPLICITLY (axis_name pvary's the params — identity on legacy
+        # jax — so no jax version's SPMD autodiff auto-psums them, and
+        # reduce_fn pmean's only the router; expert grads are per-rank
+        # shards and stay local)
+        def reduce_grads(g):
+            return {"experts": g["experts"],
+                    "router": jax.lax.pmean(g["router"], axis)}
+        train = amp.make_train_step(a, loss_fn, axis_name=axis,
+                                    reduce_fn=reduce_grads,
+                                    finite_axes=(axis,))
+    else:
+        train = amp.make_train_step(a, loss_fn, finite_axes=(axis,))
 
     def train_step(state, xb):
         new_state, metrics = train(state, xb)
@@ -134,7 +148,7 @@ def main():
     state_specs = jtu.tree_map_with_path(
         lambda path, leaf: P(axis) if match(jtu.keystr(path), leaf) else P(),
         state)
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(shard_map(
         train_step, mesh=mesh,
         in_specs=(state_specs, data_spec),
         out_specs=(state_specs, P())))

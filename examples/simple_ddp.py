@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
+from apex_tpu.utils.jax_compat import shard_map
 
 
 def parse_args():
@@ -89,7 +90,7 @@ def main():
         return optax.apply_updates(p, updates), opt_state, \
             jax.lax.pmean(loss, "data")
 
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(shard_map(
         train_step, mesh=mesh,
         in_specs=(P(), P(), P("data"), P("data")),
         out_specs=(P(), P(), P())))

@@ -14,6 +14,7 @@ from jax.sharding import PartitionSpec as P
 from apex_tpu import amp
 from apex_tpu.models.mlp import MLP, cross_entropy_loss
 from apex_tpu.parallel import DistributedDataParallel, data_parallel_mesh
+from apex_tpu.utils.jax_compat import shard_map
 
 WORLD = 8
 
@@ -42,7 +43,7 @@ def test_master_and_model_params_consistent_across_ranks(mesh):
         s2, m = inner(s, x, y)
         return s2, jax.lax.pmean(m["loss"], "data")
 
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(shard_map(
         sharded, mesh=mesh, in_specs=(P(), P("data"), P("data")),
         out_specs=(P(), P())))
 

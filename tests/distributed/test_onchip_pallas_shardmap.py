@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
+from apex_tpu.utils.jax_compat import shard_map
 
 pytestmark = pytest.mark.skipif(
     jax.default_backend() == "cpu",
@@ -48,7 +49,7 @@ def test_pallas_train_step_under_shard_map(monkeypatch):
         state, m = inner(state, xb, yb)
         return state, jax.lax.pmean(m["loss"], "data")
 
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(shard_map(
         train_step, mesh=mesh,
         in_specs=(P(), P("data"), P("data")),
         out_specs=(P(), P())))
@@ -80,7 +81,7 @@ def test_pallas_multi_tensor_under_shard_map(monkeypatch):
         total, per = multi_tensor_l2norm(4096, [outs], per_tensor=True)
         return total, per, flag
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         body, mesh=mesh, in_specs=(P(), P(), P()),
         out_specs=(P(), P(), P())))
     total, per, flag = f(*xs)

@@ -20,6 +20,7 @@ from jax.sharding import PartitionSpec as P
 from apex_tpu import amp
 from apex_tpu.models.mlp import MLP, cross_entropy_loss
 from apex_tpu.parallel import Reducer, data_parallel_mesh, pvary_params
+from apex_tpu.utils.jax_compat import shard_map
 
 WORLD = 8
 N_MICRO = 2
@@ -95,14 +96,14 @@ def test_manual_reducer_cadence_matches_big_batch(mesh):
                                             stashed_grads=reduced)
         return new_state, info["overflow"]
 
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(shard_map(
         manual, mesh=mesh,
         in_specs=(P(), P("data"), P("data")), out_specs=(P(), P())))
     acc_state, overflow = step(state0, x, y)
     assert not bool(overflow)
 
     # plain DDP big-batch reference: every-step reduce, same global batch
-    big = jax.jit(jax.shard_map(
+    big = jax.jit(shard_map(
         _invariant_step(amp.make_train_step(a, loss_fn, axis_name="data")),
         mesh=mesh, in_specs=(P(), P("data"), P("data")),
         out_specs=(P(), P())))
@@ -126,7 +127,7 @@ def test_compiled_accum_with_reducer_matches_manual(mesh):
     reducer = Reducer(axis_name="data")
     state0 = a.init(params)
 
-    compiled = jax.jit(jax.shard_map(
+    compiled = jax.jit(shard_map(
         _invariant_step(amp.make_train_step(
             a, loss_fn, axis_name="data", reduce_fn=reducer.reduce,
             accum_steps=N_MICRO)),
@@ -135,7 +136,7 @@ def test_compiled_accum_with_reducer_matches_manual(mesh):
     comp_state, m = compiled(state0, x, y)
     assert not bool(m["overflow"])
 
-    big = jax.jit(jax.shard_map(
+    big = jax.jit(shard_map(
         _invariant_step(amp.make_train_step(a, loss_fn, axis_name="data")),
         mesh=mesh, in_specs=(P(), P("data"), P("data")),
         out_specs=(P(), P())))
@@ -156,7 +157,7 @@ def test_reducer_cadence_overflow_on_one_rank_skips_globally(mesh):
     state0 = a.init(params)
     x_bad = x.at[0, 0].set(jnp.inf)      # rank 0, micro 0
 
-    compiled = jax.jit(jax.shard_map(
+    compiled = jax.jit(shard_map(
         _invariant_step(amp.make_train_step(
             a, loss_fn, axis_name="data", reduce_fn=reducer.reduce,
             accum_steps=N_MICRO)),

@@ -15,6 +15,7 @@ from jax.sharding import PartitionSpec as P
 
 from apex_tpu.attention import attention, ring_attention, ulysses_attention
 from apex_tpu.parallel import data_parallel_mesh
+from apex_tpu.utils.jax_compat import shard_map
 
 WORLD = 8
 B, L, H, D = 2, 64, 8, 16   # L/W = 8 per device
@@ -46,7 +47,7 @@ def _run_sharded(mesh, fn, q, k, v, kv_mask=None):
     if kv_mask is not None:
         in_specs.append(P(None, "data"))
         args.append(kv_mask)
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         fn, mesh=mesh, in_specs=tuple(in_specs),
         out_specs=P(None, "data")))(*args)
 
@@ -96,20 +97,28 @@ def test_fully_masked_rows_are_zero(mesh):
 
 
 def test_ring_gradients_match(mesh):
+    """Differentiated OUTSIDE the shard_map (the replicated-scalar-loss
+    form, like the flash-grad test below): grad-of-psum placed inside
+    the region is a jax-version semantic (legacy shard_map transposes
+    it to a W-times-counted cotangent; the VMA API doesn't), while this
+    form pins the package contract — ring backward == full-attention
+    backward — identically on both."""
     q, k, v = _qkv(4)
 
-    def loss_sharded(q, k, v):
-        o = ring_attention(q, k, v, "data", causal=True)
-        return jax.lax.psum(jnp.sum(o.astype(jnp.float32) ** 2), "data")
+    def sharded_loss(q, k, v):
+        def inner(q, k, v):
+            o = ring_attention(q, k, v, "data", causal=True)
+            return jax.lax.psum(jnp.sum(o.astype(jnp.float32) ** 2),
+                                "data")
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(P(None, "data"),) * 3, out_specs=P())(q, k, v)
 
     def loss_ref(q, k, v):
         o = _reference(q, k, v, causal=True)
         return jnp.sum(o.astype(jnp.float32) ** 2)
 
-    got = jax.jit(jax.shard_map(
-        jax.grad(loss_sharded, argnums=(0, 1, 2)), mesh=mesh,
-        in_specs=(P(None, "data"),) * 3,
-        out_specs=(P(None, "data"),) * 3))(q, k, v)
+    got = jax.grad(sharded_loss, argnums=(0, 1, 2))(q, k, v)
     want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for g, w in zip(got, want):
         np.testing.assert_allclose(np.asarray(g), np.asarray(w),
@@ -178,9 +187,15 @@ def test_ring_flash_gradients_match_reference(mesh):
         def inner(q, k, v):
             o = ring_attention(q, k, v, "data", causal=True, impl="flash")
             return jax.lax.psum(jnp.sum(jnp.sin(o)), "data")
-        return jax.shard_map(
-            inner, mesh=mesh,
-            in_specs=(P(None, "data"),) * 3, out_specs=P())(q, k, v)
+        # check_rep=False (legacy jax only; a no-op on the VMA API): the
+        # flash path's lax.switch trips "branches of cond produced
+        # mismatched replication types" in the legacy checker, which jax
+        # itself flags as a bug with this exact workaround.  Safe here:
+        # grads are wrt sharded inputs only, where the unrewritten psum
+        # transpose is correct.
+        return shard_map(
+            inner, mesh=mesh, in_specs=(P(None, "data"),) * 3,
+            out_specs=P(), check_rep=False)(q, k, v)
 
     def ref_loss(q, k, v):
         return jnp.sum(jnp.sin(_reference(q, k, v, causal=True)))
@@ -217,7 +232,7 @@ def test_ring_flash_kernel_on_tpu():
                           jnp.float32)
 
     def run(qq):
-        return jax.shard_map(
+        return shard_map(
             lambda q: ring_attention(q, q, q, "data", causal=True,
                                      impl="flash"),
             mesh=mesh, in_specs=(P(None, "data"),),

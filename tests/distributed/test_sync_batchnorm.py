@@ -19,6 +19,7 @@ from apex_tpu.parallel import (
     data_parallel_mesh,
     welford_parallel,
 )
+from apex_tpu.utils.jax_compat import shard_map
 
 WORLD = 8
 TOL = dict(rtol=1e-5, atol=1e-5)  # fp32 tolerance from two_gpu_unit_test.py
@@ -85,7 +86,7 @@ def test_sharded_batch_matches_whole_batch(mesh):
         y, upd = bn_sync.apply(v, xx, mutable=["batch_stats"])
         return y, upd["batch_stats"]
 
-    y_sh, stats_sh = jax.shard_map(
+    y_sh, stats_sh = shard_map(
         fwd, mesh=mesh, in_specs=(P(), P("data")),
         out_specs=(P("data"), P()))(vars_, x)
     y_ref, stats_ref = bn_local.apply(vars_, x, mutable=["batch_stats"])
@@ -112,7 +113,7 @@ def test_sync_bn_gradients_match_whole_batch(mesh):
             y, _ = bn_sync.apply(v, xb, mutable=["batch_stats"])
             # psum the local loss so the total matches the whole-batch loss
             return jax.lax.psum(jnp.sum(jnp.sin(y)), "data")
-        return jax.shard_map(
+        return shard_map(
             inner, mesh=mesh, in_specs=(P(), P("data")),
             out_specs=P())(v, xx)
 
@@ -142,7 +143,7 @@ def test_process_groups(mesh):
         y, _ = bn.apply(v, xx, mutable=["batch_stats"])
         return y
 
-    y = jax.shard_map(fwd, mesh=mesh, in_specs=(P(), P("data")),
+    y = shard_map(fwd, mesh=mesh, in_specs=(P(), P("data")),
                       out_specs=P("data"))(vars_, x)
     # Each half of the batch normalized with its own group's stats.
     y_ref0, _, _ = ref_bn(np.asarray(x)[:8])
@@ -167,7 +168,7 @@ def test_process_group_gradients_match_per_group_reference(mesh):
         def inner(v, xb):
             y, _ = bn.apply(v, xb, mutable=["batch_stats"])
             return jax.lax.psum(jnp.sum(jnp.sin(y)), "data")
-        return jax.shard_map(inner, mesh=mesh,
+        return shard_map(inner, mesh=mesh,
                              in_specs=(P(), P("data")),
                              out_specs=P())(v, xx)
 
@@ -295,7 +296,7 @@ class TestFusedBackwardFlag:
                 return fwd(params, xin)
             mesh = jax.sharding.Mesh(np.array(jax.devices()[:4]),
                                      (axis_name,))
-            return jax.shard_map(
+            return shard_map(
                 lambda p, xb: jax.lax.pmean(fwd(p, xb), axis_name),
                 mesh=mesh, in_specs=(P(), P(axis_name)),
                 out_specs=P())(params, xin)
@@ -318,7 +319,7 @@ class TestFusedBackwardFlag:
         v = bn.init(jax.random.PRNGKey(1), x, use_running_average=False)
         mesh = jax.sharding.Mesh(np.array(jax.devices()[:4]), ("data",))
         with pytest.raises(ValueError, match="process_group"):
-            jax.shard_map(
+            shard_map(
                 lambda p, xb: bn.apply(
                     {"params": p, "batch_stats": v["batch_stats"]}, xb,
                     use_running_average=False, mutable=["batch_stats"])[0],

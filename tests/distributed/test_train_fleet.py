@@ -413,6 +413,142 @@ def test_shrink_regrow_roundtrip_8_4_8_bitwise_with_preflight(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# replan leadership (review round): a returning minimum rank must not
+# deadlock the regrow, and a dead generation must not strand a joiner
+# ---------------------------------------------------------------------------
+
+def _plan(gen, members, **kw):
+    return {"gen": gen, "members": members, "port": 1,
+            "restore_step": None, "reason": "initial",
+            "created_by": members[0], "created_ts": time.time(),
+            "incarnations": {str(r): 0 for r in members}, **kw}
+
+
+def test_replan_leader_is_surviving_member_not_returning_min_rank(tmp_path):
+    """Kill rank 0 and let it return: the regrow replan must be led by
+    the SURVIVING member (rank 1), not by bare min(live)=0 — the
+    returning rank sits in supervise's joiner branch and never writes
+    plans, so electing it would leave the survivor waiting
+    replan_window_s for a plan that cannot appear."""
+    led = FleetLedger(str(tmp_path))
+    cfg = FleetConfig(world_size=2, lease_ttl_s=5.0, poll_s=0.01,
+                      replan_window_s=10.0)
+    assert led.write_plan(_plan(0, [0, 1]))
+    assert led.write_plan(_plan(1, [1], reason="shrink"))
+    led.announce(0), led.heartbeat(0)     # rank 0 is back: lease fresh
+    led.announce(1), led.heartbeat(1)
+    t0 = time.monotonic()
+    plan = fleet_mod._await_next_plan(led, cfg, rank=1, gen=1)
+    # member preference decided immediately — not via the grace fallback
+    assert time.monotonic() - t0 < cfg.replan_window_s / 2
+    assert plan["gen"] == 2
+    assert plan["members"] == [0, 1]
+    assert plan["reason"] == "regrow"
+    assert plan["created_by"] == 1
+
+
+def test_replan_grace_lets_waiting_member_pass_a_stalled_leader(tmp_path):
+    """The elected member (min live member) can itself be wedged while
+    its supervisor lease stays fresh: after half the replan window any
+    waiting member commits the plan itself (O_EXCL arbitrates), so the
+    fleet replans instead of timing out."""
+    led = FleetLedger(str(tmp_path))
+    cfg = FleetConfig(world_size=2, lease_ttl_s=10.0, poll_s=0.02,
+                      replan_window_s=2.0)
+    assert led.write_plan(_plan(0, [0, 1]))
+    led.announce(0), led.heartbeat(0)     # leader rank 0: fresh, silent
+    led.announce(1), led.heartbeat(1)
+    t0 = time.monotonic()
+    plan = fleet_mod._await_next_plan(led, cfg, rank=1, gen=0)
+    assert time.monotonic() - t0 >= cfg.replan_window_s / 2 - 0.1
+    assert plan["created_by"] == 1 and plan["reason"] == "reform"
+    assert plan["members"] == [0, 1]
+
+
+def test_joiner_takes_over_only_when_every_member_lease_is_stale(tmp_path):
+    """A joiner polling a generation whose members ALL crashed (every
+    lease stale, nobody left in _await_next_plan) commits the next
+    plan itself instead of waiting forever; while any member is fresh
+    it stays a polite joiner."""
+    led = FleetLedger(str(tmp_path))
+    cfg = FleetConfig(lease_ttl_s=0.2, poll_s=0.0)
+    led.announce(0), led.heartbeat(0)
+    led.announce(1)
+    plan = _plan(0, [0])
+    assert led.write_plan(plan)
+    led.heartbeat(1)
+    assert not fleet_mod._take_over_dead_generation(led, cfg, 1, plan)
+    time.sleep(0.3)                       # member 0's lease goes stale
+    led.heartbeat(1)                      # the joiner stays fresh
+    assert fleet_mod._take_over_dead_generation(led, cfg, 1, plan)
+    nxt = led.read_plan(1)
+    assert nxt["members"] == [1] and nxt["created_by"] == 1
+    assert "takeover" in [e["kind"] for e in led.events()]
+
+
+def test_formation_death_replans_instead_of_cascading_fatal(tmp_path):
+    """A peer dying during cluster FORMATION must end in a replan, not
+    total fleet death.  jax's distributed client LOG(FATAL)s the child
+    (SIGABRT — no Python except path) when its peer never arrives, so
+    the SUPERVISOR applies the lease classification to the hard exit:
+    with the peer's lease stale it replans onto the smaller mesh and
+    finishes, instead of recording rank_fatal and stopping its lease
+    (which cascaded one rank's formation death into every rank's)."""
+    from apex_tpu.parallel.multiproc import _free_port
+    led = FleetLedger(str(tmp_path))
+    cfg = FleetConfig(num_steps=3, checkpoint_every=2, world_size=2,
+                      lease_ttl_s=0.5, heartbeat_s=0.1, poll_s=0.05,
+                      init_timeout_s=2.0, init_retries=0,
+                      replan_window_s=30.0)
+    led.write_config(cfg)
+    led.announce(0)
+    led.heartbeat(0)      # stale long before init gives up: a dead peer
+    # gen 0 plans ranks {0, 1}, but rank 0 is already gone and its
+    # coordinator port has no listener: rank 1's child dies in
+    # formation (SIGABRT from the distributed client)
+    assert led.write_plan(_plan(0, [0, 1], port=_free_port()))
+    env = dict(os.environ)
+    for var in ("XLA_FLAGS", "COORDINATOR_ADDRESS", "WORLD_SIZE", "RANK"):
+        env.pop(var, None)
+    env["PYTHONPATH"] = str(REPO_ROOT) + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.resilience.fleet",
+         "--role", "supervisor", "--ledger", str(tmp_path),
+         "--rank", "1"],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, (r.returncode, r.stdout[-2000:],
+                               r.stderr[-2000:])
+    events = led.events()
+    kinds = [e["kind"] for e in events]
+    assert "child_death_reclassified" in kinds      # not rank_fatal
+    assert "rank_fatal" not in kinds
+    hard = next(e for e in events
+                if e["kind"] == "child_death_reclassified")
+    assert hard["reason"] == "shrink" and hard["ranks"] == [0]
+    # the supervisor speaks the child's vocabulary: canonical
+    # shrink_detected event + schema-valid fleet-shrink incident with
+    # a flight tail (the child died too hard to write its own)
+    shr = next(e for e in events if e["kind"] == "shrink_detected")
+    assert shr["via"] == "supervisor" and shr["ranks"] == [0]
+    from apex_tpu.resilience.incidents import validate_incident_file
+    inc_dir = led.path("incidents")
+    shrink_incs = [os.path.join(inc_dir, n) for n in os.listdir(inc_dir)
+                   if "fleet-shrink" in n]
+    assert shrink_incs and all(
+        validate_incident_file(p) == [] for p in shrink_incs)
+    with open(shrink_incs[0]) as f:
+        tail = {ev["kind"] for ev in json.load(f)["flight"]["events"]}
+    assert {"kill", "shrink_detected"} <= tail
+    plan1 = led.read_plan(1)
+    assert plan1["members"] == [1] and plan1["reason"] == "shrink"
+    finals = led.finals()
+    assert sorted(finals) == [1]
+    assert finals[1]["step"] == cfg.num_steps - 1
+
+
+# ---------------------------------------------------------------------------
 # the real 2-process SIGKILL drill (slow lane)
 # ---------------------------------------------------------------------------
 

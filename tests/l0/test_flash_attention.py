@@ -349,6 +349,8 @@ class TestRopeFused:
         this path in the CPU dryruns."""
         import numpy as onp
         from jax.sharding import Mesh, PartitionSpec as P
+
+        from apex_tpu.utils.jax_compat import shard_map
         # 2-way data mesh on CPU (8 virtual devices); on the one-chip
         # TPU a 1-device mesh still compiles flash+rope under shard_map
         # (the kernel path — hardware coverage the fallback test line
@@ -362,7 +364,7 @@ class TestRopeFused:
         def fwd(q, k, v, cos, sin):
             return flash_attention(q, k, v, rope=(cos, sin), **kw)
 
-        out = jax.shard_map(
+        out = shard_map(
             fwd, mesh=mesh,
             in_specs=(P("data"), P("data"), P("data"), P("data"),
                       P("data")),

@@ -17,6 +17,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from apex_tpu import amp
 from apex_tpu.models import GPTModel, gpt_tiny, lm_loss
 from apex_tpu.optimizers import FusedAdam
+from apex_tpu.utils.jax_compat import shard_map
 
 B, L = 2, 32
 
@@ -79,7 +80,7 @@ class TestGPT:
             return model_sp.apply(v, ids_shard, positions=pos_shard)
 
         positions = jnp.broadcast_to(jnp.arange(L)[None, :], (B, L))
-        sharded = jax.shard_map(
+        sharded = shard_map(
             fwd, mesh=mesh,
             in_specs=(P(), P(None, "seq"), P(None, "seq")),
             out_specs=P(None, "seq"))(self.vars, self.ids, positions)

@@ -25,6 +25,7 @@ from apex_tpu.models import (
 )
 from apex_tpu.optimizers import FusedAdam, fused_lamb
 from apex_tpu.parallel import DistributedDataParallel, data_parallel_mesh
+from apex_tpu.utils.jax_compat import shard_map
 
 
 class TestResNet:
@@ -136,7 +137,7 @@ class TestResNet:
                                          mutable=["batch_stats"])
             return logits
 
-        logits = jax.shard_map(
+        logits = shard_map(
             fwd, mesh=mesh, in_specs=(P(), P("data")),
             out_specs=P("data"))(variables, x8)
         assert logits.shape == (8, 10)

@@ -100,6 +100,7 @@ def _wait_for(pred, timeout_s: float, what: str, poll_s: float = 0.1):
         val = pred()
         if val:
             return val
+        time.sleep(poll_s)  # preds rescan events/: don't peg a core
     raise DrillError(f"timed out after {timeout_s:g}s waiting for {what}")
 
 
