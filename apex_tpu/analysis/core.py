@@ -135,6 +135,11 @@ class PassContext:
     #: weight intent from here; ``None`` degrades it to policy-free
     #: dtype checks.
     policy: Optional[Any] = None
+    #: the traced ``ClosedJaxpr`` of the program, when the caller
+    #: captured one — the ``pallas-kernel`` pass reads grid/BlockSpec/
+    #: index-map structure from here (StableHLO has already erased it);
+    #: ``None`` degrades that pass to an info "skipped" finding.
+    closed_jaxpr: Optional[Any] = None
     #: derived-table memo (alias set, kept-index map, donation table)
     #: shared across passes — every derived table is a pure function of
     #: one lowering's text, so it is parsed once per context, not once
@@ -300,19 +305,22 @@ def run_passes(ctx: PassContext,
 
 
 def build_context(lowered, compile: bool = True,
-                  static_scalars=(), policy=None) -> PassContext:
+                  static_scalars=(), policy=None,
+                  closed_jaxpr=None) -> PassContext:
     """One :class:`PassContext` from one lowering: the lowered text,
     the arg/output tables, and (when ``compile``) the compiled
     executable plus its HLO text — shared by every pass so a mixed
     pass list never lowers or compiles twice.  ``policy`` (the resolved
-    ``amp.policy.Properties``) rides along for the precision pass."""
+    ``amp.policy.Properties``) rides along for the precision pass;
+    ``closed_jaxpr`` (from ``jitted.trace(...).jaxpr``) for the
+    ``pallas-kernel`` pass."""
     compiled = lowered.compile() if compile else None
     return PassContext(
         stablehlo_text=lowered.as_text(),
         hlo_text=compiled.as_text() if compiled is not None else None,
         args=_args_info(lowered), outputs=_out_info(lowered),
         compiled=compiled, static_scalars=tuple(static_scalars),
-        policy=policy)
+        policy=policy, closed_jaxpr=closed_jaxpr)
 
 
 def lower_quiet(jitted, *args, **kwargs):
@@ -365,7 +373,16 @@ def analyze(fn: Callable, *args,
     jitted = fn if hasattr(fn, "lower") else \
         jax.jit(fn, donate_argnums=donate_argnums)
     lowered = lower_quiet(jitted, *args, **kwargs)
+    closed_jaxpr = None
+    if passes is not None and "pallas-kernel" in passes:
+        # the pallas pass needs jaxpr-level structure (StableHLO has
+        # already erased BlockSpecs) — trace it alongside the lowering
+        try:
+            closed_jaxpr = jitted.trace(*args, **kwargs).jaxpr
+        except Exception:  # noqa: BLE001 - pass degrades to "skipped"
+            closed_jaxpr = None
     ctx = build_context(
         lowered, compile=compile, policy=policy,
-        static_scalars=_static_scalars(args, kwargs, lowered.args_info))
+        static_scalars=_static_scalars(args, kwargs, lowered.args_info),
+        closed_jaxpr=closed_jaxpr)
     return run_passes(ctx, passes=passes, options=options)

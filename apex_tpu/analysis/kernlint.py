@@ -1,0 +1,183 @@
+"""KERNLINT_r*.json — schema for the committed Pallas-sanitizer sweep.
+
+``tools/kernel_lint.py --out KERNLINT_rN.json`` writes one of these
+per round: every hand-written Pallas kernel (adam, lamb stage-1/2,
+layer_norm fwd/bwd, multi_tensor, flash_attention, the experimental
+kernels) traced across the geometry ladder and adversarial ragged
+shapes, run through all six :mod:`apex_tpu.analysis.pallas_lint`
+rules, with per-kernel per-rule finding counts and a verdict.  Like
+MEMLINT/PRECLINT/FLEETLINT, the artifact is gate memory:
+``tools/gate_hygiene.py`` validates every committed ``KERNLINT_r*.json``
+against this schema so "the kernels are race-free, covered, and under
+budget" can't rot into prose nobody machine-checks.
+
+This module is deliberately **stdlib-only** (no jax import):
+``gate_hygiene`` loads it directly by file path the same way it loads
+``analysis/memlint.py`` and ``analysis/fleetlint.py``.
+
+Document shape::
+
+    {
+      "round": 1,
+      "platform": "cpu",
+      "budget_mb": 16.0,           # the VMEM working-set ceiling used
+      "rules": ["pallas-parallel-race", ...],   # the full rule list
+      "kernels": {
+        "<kernel>": {              # e.g. "fused_adam", "layer_norm"
+          "ok": true,              # MUST re-derive from the counts below
+          "configs": 4,            # (shape, dtype, knob) points swept
+          "calls": 6,              # pallas_call sites linted (>= configs)
+          "findings": {            # per-rule ERROR counts over the sweep
+            "pallas-vmem-overflow": 0, ...      # keys subset of "rules"
+          },
+          "waivers": {             # optional: rule -> documented reason;
+            "<rule>": "why"        #   a waived rule needs findings > 0
+          },                       #   (a waiver with none is stale)
+          "error": "..."           # optional: sweep crashed; forces
+        }, ...                     #   ok=false
+      },
+      "gate": {"ok": true, "kernels_clean": 9,
+               "kernels_total": 9}               # re-derived
+    }
+
+The contradiction rules: a kernel's ``ok`` must equal "zero unwaived
+finding counts and no error" — a clean verdict sitting on recorded
+findings is invalid, as is a waiver citing a rule that never fired;
+``gate.ok``/``kernels_clean``/``kernels_total`` must re-derive from the
+per-kernel verdicts.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+#: the six pallas_lint rule ids (mirrored here so the validator stays
+#: stdlib-only; ``tests/l0/test_pallas_lint.py`` pins the two lists
+#: equal so they cannot drift)
+RULES = ("pallas-parallel-race", "pallas-alias-race",
+         "pallas-oob-unmasked", "pallas-uncovered-output",
+         "pallas-vmem-overflow", "pallas-seq-accum-parallel")
+
+
+def _validate_kernel(name: str, rec: dict, rules: tuple,
+                     problems: List[str]) -> None:
+    if not isinstance(rec.get("ok"), bool):
+        problems.append(f"kernel {name!r} missing/invalid 'ok' (bool)")
+        return
+    for key in ("configs", "calls"):
+        if not (isinstance(rec.get(key), int) and rec[key] >= 0):
+            problems.append(f"kernel {name!r} missing/invalid {key!r} "
+                            f"(int >= 0)")
+            return
+    findings = rec.get("findings")
+    if not isinstance(findings, dict):
+        problems.append(f"kernel {name!r} missing 'findings' object")
+        return
+    for rule, count in findings.items():
+        if rule not in rules:
+            problems.append(f"kernel {name!r} records unknown rule "
+                            f"{rule!r} (schema knows {sorted(rules)})")
+        if not (isinstance(count, int) and count >= 0):
+            problems.append(f"kernel {name!r} finding count for "
+                            f"{rule!r} is not an int >= 0: {count!r}")
+            return
+    waivers = rec.get("waivers", {})
+    if not isinstance(waivers, dict):
+        problems.append(f"kernel {name!r} has invalid 'waivers' "
+                        f"(object of rule -> reason)")
+        return
+    for rule, reason in waivers.items():
+        if rule not in rules:
+            problems.append(f"kernel {name!r} waives unknown rule "
+                            f"{rule!r}")
+        if not (isinstance(reason, str) and reason.strip()):
+            problems.append(f"kernel {name!r} waiver for {rule!r} "
+                            f"needs a non-empty reason")
+        if findings.get(rule, 0) == 0:
+            problems.append(f"kernel {name!r} waives {rule!r} which "
+                            f"recorded no findings (stale waiver)")
+    error = rec.get("error")
+    if error is not None and not (isinstance(error, str)
+                                  and error.strip()):
+        problems.append(f"kernel {name!r} has invalid 'error' "
+                        f"(non-empty str)")
+
+    # the contradiction rule: the verdict must re-derive from the
+    # recorded evidence — unwaived counts and the error field
+    unwaived = sum(c for rule, c in findings.items()
+                   if isinstance(c, int) and rule not in waivers)
+    derived = unwaived == 0 and error is None
+    if rec["ok"] != derived:
+        if error is not None:
+            why = f"a recorded sweep error ({error[:60]!r})"
+        elif unwaived:
+            why = f"{unwaived} unwaived finding(s)"
+        else:
+            why = "zero unwaived findings and no error"
+        problems.append(f"kernel {name!r}: ok={rec['ok']} contradicts "
+                        f"{why}")
+    if rec["calls"] < rec["configs"] and error is None:
+        problems.append(f"kernel {name!r}: {rec['calls']} linted "
+                        f"call(s) over {rec['configs']} config(s) — "
+                        f"some configs produced no pallas_call and no "
+                        f"'error' explains it")
+
+
+def validate_kernlint(doc) -> List[str]:
+    """Problems with one parsed KERNLINT document (empty = valid)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not a JSON object"]
+    if not isinstance(doc.get("round"), int):
+        problems.append("missing/invalid 'round' (int)")
+    if not isinstance(doc.get("platform"), str):
+        problems.append("missing/invalid 'platform' (str)")
+    budget = doc.get("budget_mb")
+    if not (isinstance(budget, (int, float)) and budget > 0):
+        problems.append("missing/invalid 'budget_mb' (number > 0)")
+    rules = doc.get("rules")
+    if not (isinstance(rules, list) and rules
+            and all(isinstance(r, str) for r in rules)):
+        problems.append("missing/invalid 'rules' (non-empty list of "
+                        "rule-id strings)")
+        rules = list(RULES)
+    kernels = doc.get("kernels")
+    if not isinstance(kernels, dict) or not kernels:
+        return problems + ["missing/empty 'kernels' object"]
+    for name, rec in kernels.items():
+        if not isinstance(rec, dict):
+            problems.append(f"kernel {name!r} is not an object")
+            continue
+        _validate_kernel(name, rec, tuple(rules), problems)
+
+    gate = doc.get("gate")
+    if not isinstance(gate, dict):
+        problems.append("missing 'gate' object")
+        return problems
+    clean = sum(1 for rec in kernels.values()
+                if isinstance(rec, dict) and rec.get("ok") is True)
+    total = len(kernels)
+    if not isinstance(gate.get("ok"), bool):
+        problems.append("gate missing/invalid 'ok' (bool)")
+    elif gate["ok"] != (clean == total):
+        problems.append(f"gate.ok={gate['ok']} contradicts the kernel "
+                        f"verdicts ({clean}/{total} clean)")
+    for key, want in (("kernels_clean", clean),
+                      ("kernels_total", total)):
+        if not isinstance(gate.get(key), int):
+            problems.append(f"gate missing/invalid {key!r} (int)")
+        elif gate[key] != want:
+            problems.append(f"gate.{key}={gate[key]} contradicts the "
+                            f"kernel records (counted {want})")
+    return problems
+
+
+def validate_kernlint_file(path: str) -> List[str]:
+    """Problems with one KERNLINT_r*.json file (empty = valid)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable kernlint JSON: {e}"]
+    return validate_kernlint(doc)

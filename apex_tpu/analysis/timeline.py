@@ -306,6 +306,35 @@ def _ingest_fleetlint(doc, prev) -> List[Row]:
     return rows
 
 
+@adapter("KERNLINT")
+def _ingest_kernlint(doc, prev) -> List[Row]:
+    """Pallas kernel-sanitizer rounds: per-kernel clean verdict (1.0 =
+    zero unwaived rule findings over the sweep) and total error-finding
+    count, plus the gate's clean-kernel fraction — the longitudinal
+    record that every hand-written kernel stays race-free, covered,
+    and under the VMEM budget."""
+    rows: List[Row] = []
+    for name, rec in sorted((doc.get("kernels") or {}).items()):
+        if not isinstance(rec, dict):
+            continue
+        if isinstance(rec.get("ok"), bool):
+            rows.append((f"kernel:{name}", "lint_clean",
+                         float(rec["ok"])))
+        findings = rec.get("findings")
+        if isinstance(findings, dict):
+            total = sum(v for v in findings.values() if _num(v))
+            rows.append((f"kernel:{name}", "rule_findings",
+                         float(total)))
+    gate = doc.get("gate")
+    if isinstance(gate, dict) and _num(gate.get("kernels_total")) \
+            and gate["kernels_total"] > 0 \
+            and _num(gate.get("kernels_clean")):
+        rows.append(("gate", "kernels_clean_frac",
+                     round(gate["kernels_clean"]
+                           / gate["kernels_total"], 4)))
+    return rows
+
+
 @adapter("PREFIXCACHE")
 def _ingest_prefixcache(doc, prev) -> List[Row]:
     """Prefix-sharing rounds: per-arm deterministic counts (prefill

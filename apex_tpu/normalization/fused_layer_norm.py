@@ -59,7 +59,7 @@ def fused_layer_norm_affine(x: jax.Array,
     n1 = x.size // n2
 
     from apex_tpu.ops.pallas import layer_norm_kernels as lnk
-    if use_pallas() and lnk.supported(n2):
+    if use_pallas() and lnk.supported(n2, x.dtype):
         x2d = x.reshape(n1, n2)
         w = None if weight is None else weight.reshape(n2)
         b = None if bias is None else bias.reshape(n2)

@@ -23,8 +23,9 @@ reductions.  The BACKWARD keeps the fixed 128-row blocks: its dγ/dβ
 partials accumulate across the sequential grid, so the block size sets
 the summation ORDER — part of the bit-exact digest contract the L1
 conformance tier pins — and its rows stay padded to the block multiple.
-Feature dims not divisible by 128 fall back to the jnp path at the call
-site (`supported`).
+Feature dims not divisible by 128 — or wide enough that the backward's
+fixed-row blocks no longer fit double-buffered in the VMEM budget —
+fall back to the jnp path at the call site (`supported`).
 """
 
 from __future__ import annotations
@@ -54,8 +55,25 @@ def fwd_block_rows(n1: int, n2: int, x_dtype,
         max(n1, 1), row_bytes=n2 * 2 * xb + 8, multiple_of=16)
 
 
-def supported(n2: int) -> bool:
-    return n2 % 128 == 0 and n2 <= 16384
+def supported(n2: int, dtype=None) -> bool:
+    """Whether the fused pallas path handles an ``n2``-wide feature dim.
+
+    With a ``dtype`` the check is budget-aware: the BACKWARD streams
+    x/dy/dx blocks of fixed ``_BLOCK_ROWS`` rows (the block size sets
+    the dγ/dβ summation order — part of the bit-exact digest contract —
+    so it cannot shrink with the feature dim), and a wide-enough row
+    no longer fits double-buffered in VMEM.  Those shapes route to the
+    jnp fallback instead of shipping a kernel the Pallas sanitizer
+    rejects with ``pallas-vmem-overflow`` (fp32 caps near n2=5376 at
+    the default budget, bf16 near n2=10752)."""
+    if n2 % 128 != 0 or n2 > 16384:
+        return False
+    if dtype is None:
+        return True
+    itemsize = jnp.dtype(dtype).itemsize
+    streams = 2 * 3 * _BLOCK_ROWS * n2 * itemsize   # x, dy, dx x2 buffers
+    tables = 3 * 4 * n2 + 2 * 2 * 4 * _BLOCK_ROWS   # w/dw/db + mean/inv
+    return streams + tables <= 2 * geometry.vmem_budget()
 
 
 def _fwd_kernel(x_ref, w_ref, b_ref, y_ref, mean_ref, inv_ref, *, eps,

@@ -1446,3 +1446,82 @@ def test_repo_trainfleet_validates():
     shrink = next(r for r in doc["recoveries"] if r["reason"] == "shrink")
     assert 0 <= shrink["steps_lost"] <= doc["config"]["checkpoint_every"]
     assert any(e["kind"] == "kill" for e in doc["events"])
+
+
+# ---------------------------------------------------------------------------
+# KERNLINT_r*.json — the Pallas kernel sanitizer sweep artifacts
+# ---------------------------------------------------------------------------
+
+def _valid_kernlint():
+    rules = ["pallas-parallel-race", "pallas-alias-race",
+             "pallas-oob-unmasked", "pallas-uncovered-output",
+             "pallas-vmem-overflow", "pallas-seq-accum-parallel"]
+    return {"round": 1, "platform": "cpu", "budget_mb": 16.0,
+            "rules": rules,
+            "kernels": {"fused_adam": {
+                "ok": True, "configs": 2, "calls": 3,
+                "findings": {r: 0 for r in rules}}},
+            "gate": {"ok": True, "kernels_clean": 1,
+                     "kernels_total": 1}}
+
+
+def test_committed_kernlint_validated_against_schema(tmp_repo):
+    _analysis_module(tmp_repo, "kernlint")
+    (tmp_repo / "KERNLINT_r07.json").write_text('{"round": 7}')
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "bad kernel record")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("KERNLINT_r07.json" in p
+               for p in verdict["invalid_kernlints"])
+    assert gate_hygiene.main(["--repo", str(tmp_repo)]) == 1
+
+
+def test_kernlint_contradictory_verdict_fails_hygiene(tmp_repo):
+    """A clean kernel verdict sitting on recorded unwaived findings is
+    the lie the schema exists to reject — "the kernels are race-free
+    and under budget" must re-derive from the finding counts."""
+    _analysis_module(tmp_repo, "kernlint")
+    doc = _valid_kernlint()
+    doc["kernels"]["fused_adam"]["findings"]["pallas-vmem-overflow"] = 2
+    (tmp_repo / "KERNLINT_r08.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "asserted kernel cleanliness")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("contradicts" in p for p in verdict["invalid_kernlints"])
+
+
+def test_kernlint_stale_waiver_fails_hygiene(tmp_repo):
+    """A waiver citing a rule that never fired is dead documentation —
+    it would silently excuse a FUTURE regression of that rule."""
+    _analysis_module(tmp_repo, "kernlint")
+    doc = _valid_kernlint()
+    doc["kernels"]["fused_adam"]["waivers"] = {
+        "pallas-oob-unmasked": "masked tail, verified by hand"}
+    (tmp_repo / "KERNLINT_r08.json").write_text(json.dumps(doc))
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "stale kernel waiver")
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]
+    assert any("stale waiver" in p for p in verdict["invalid_kernlints"])
+
+
+def test_valid_kernlint_passes_and_untracked_fails(tmp_repo):
+    _analysis_module(tmp_repo, "kernlint")
+    (tmp_repo / "KERNLINT_r09.json").write_text(
+        json.dumps(_valid_kernlint()))
+    verdict = gate_hygiene.check(str(tmp_repo))
+    assert not verdict["ok"]            # parked-but-untracked
+    assert verdict["untracked"] == ["KERNLINT_r09.json"]
+    _git(tmp_repo, "add", "-A")
+    _git(tmp_repo, "commit", "-q", "-m", "kernel lint round")
+    assert gate_hygiene.check(str(tmp_repo))["ok"]
+
+
+def test_repo_kernlint_validates():
+    """The committed KERNLINT_r01 is the schema's reference instance
+    (it rides the repo-level hygiene check in tier-1)."""
+    assert gate_hygiene._validate_kernlints(str(REPO)) == []
+    assert sorted(REPO.glob("KERNLINT_r*.json")), \
+        "the kernel sanitizer gate artifact must be committed"

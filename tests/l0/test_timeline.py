@@ -55,7 +55,7 @@ def test_every_committed_family_has_an_adapter():
                    "SCENARIO", "SERVE_DISAGG", "TRACE", "OBS",
                    "EXPORT", "CONVERGENCE", "DECODE_PROFILE",
                    "DECODE_DECOMPOSE", "BENCH_VARIANCE", "FLEETLINT",
-                   "PREFIXCACHE", "TRAINFLEET"):
+                   "PREFIXCACHE", "TRAINFLEET", "KERNLINT"):
         assert expect in fams, f"{expect} not ingested ({fams})"
     assert all(rec["files"] for rec in out["coverage"].values())
     assert sum(rec["rows"] for rec in out["coverage"].values()) > 100
@@ -81,6 +81,29 @@ def test_fleetlint_adapter_rows():
     assert ("ddp_o1_train", "consistent", 1.0) in rows
     assert ("ddp_o1_train", "n_collectives", 4.0) in rows
     assert ("gate", "inconsistent_lanes", 0.0) in rows
+
+
+def test_kernlint_adapter_rows():
+    """KERNLINT rounds chart each kernel's clean verdict as 1.0/0.0,
+    its total finding count, and the gate's clean fraction — a kernel
+    regressing into findings (or a waiver papering over them) drops a
+    charted value, not just prose."""
+    rules = ["pallas-parallel-race", "pallas-vmem-overflow"]
+    doc = {"round": 1, "platform": "cpu", "budget_mb": 16.0,
+           "rules": rules,
+           "kernels": {
+               "fused_adam": {"ok": True, "configs": 2, "calls": 3,
+                              "findings": {r: 0 for r in rules}},
+               "layer_norm": {"ok": False, "configs": 4, "calls": 6,
+                              "findings": {"pallas-vmem-overflow": 2}}},
+           "gate": {"ok": False, "kernels_clean": 1,
+                    "kernels_total": 2}}
+    rows = timeline.ADAPTERS["KERNLINT"](doc, {})
+    assert ("kernel:fused_adam", "lint_clean", 1.0) in rows
+    assert ("kernel:fused_adam", "rule_findings", 0.0) in rows
+    assert ("kernel:layer_norm", "lint_clean", 0.0) in rows
+    assert ("kernel:layer_norm", "rule_findings", 2.0) in rows
+    assert ("gate", "kernels_clean_frac", 0.5) in rows
 
 
 def test_prefixcache_adapter_rows():

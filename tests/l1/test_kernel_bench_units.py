@@ -138,6 +138,27 @@ def test_autotune_sweeps_and_chooses(monkeypatch):
     assert calls["fused_adam"][-1] == 64
 
 
+def test_autotune_refuses_lint_rejected_candidates(monkeypatch):
+    """A knob candidate the Pallas sanitizer rejects is recorded as a
+    ``lint_rejected`` dict entry — never timed, never chosen — even
+    when it would have swept fastest (the export-gate treatment)."""
+    # shrink the VMEM budget so block_rows=256 overflows the working
+    # set while block_rows=8 still fits (budget read at call time)
+    monkeypatch.setenv("APEX_TPU_VMEM_BUDGET_MB", "0.25")
+    monkeypatch.setattr(kb, "AUTOTUNE_KNOBS",
+                        {"fused_adam": ("block_rows", (8, 256))})
+    # fake timer makes the REJECTED candidate look fastest: only the
+    # lint gate can keep it out of the knob table
+    def fake_time(build, iters, trials=3):
+        return 1e-3
+    monkeypatch.setattr(kb, "_time_scan", fake_time)
+    result = kb.run_suite(tiny=True, autotune=True)
+    auto = result["kernels"]["fused_adam"]["autotune"]
+    assert auto["swept_ms"]["256"] ==         {"lint_rejected": ["pallas-vmem-overflow"]}
+    assert isinstance(auto["swept_ms"]["8"], float)
+    assert auto["chosen"] == {"block_rows": 8}
+
+
 def test_kernel_floor_gate():
     floors = kb.KERNEL_FLOORS
     assert "fused_adam" in floors and "lamb_stage1" in floors

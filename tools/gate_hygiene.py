@@ -167,7 +167,8 @@ PATTERNS = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json",
             "SERVE_DISAGG_r*.json", "SCENARIO_r*.json",
             "TRACE_r*.json", "TIMELINE_r*.json",
             "PROFILE_DRIFT_r*.json", "FLEETLINT_r*.json",
-            "PREFIXCACHE_r*.json", "TRAINFLEET_r*.json")
+            "PREFIXCACHE_r*.json", "TRAINFLEET_r*.json",
+            "KERNLINT_r*.json")
 
 #: Round-numbered incident artifacts additionally get schema-validated.
 INCIDENT_PATTERN = "INCIDENT_r*.json"
@@ -218,8 +219,11 @@ FLEETLINT_PATTERN = "FLEETLINT_r*.json"
 #: ... and the cross-request prefix-sharing gate artifacts ...
 PREFIXCACHE_PATTERN = "PREFIXCACHE_r*.json"
 
-#: ... and the elastic-training-fleet chaos-drill artifacts.
+#: ... and the elastic-training-fleet chaos-drill artifacts ...
 TRAINFLEET_PATTERN = "TRAINFLEET_r*.json"
+
+#: ... and the Pallas kernel-sanitizer sweep artifacts.
+KERNLINT_PATTERN = "KERNLINT_r*.json"
 
 
 def _load_by_path(repo: str, *rel: str):
@@ -492,6 +496,22 @@ def _validate_trainfleets(repo: str) -> "list[str]":
     return problems
 
 
+def _validate_kernlints(repo: str) -> "list[str]":
+    """Schema problems over every present KERNLINT_r*.json, as
+    ``path: problem`` strings (``apex_tpu/analysis/kernlint.py`` —
+    which also re-derives every per-kernel ``ok`` verdict from the
+    recorded per-rule finding counts and waivers, and ``gate.ok``
+    from the verdicts)."""
+    schema = _load_by_path(repo, "apex_tpu", "analysis", "kernlint.py")
+    if schema is None:
+        return []
+    problems = []
+    for p in sorted(Path(repo).glob(KERNLINT_PATTERN)):
+        for msg in schema.validate_kernlint_file(str(p)):
+            problems.append(f"{p.name}: {msg}")
+    return problems
+
+
 def _git(repo: str, *args: str) -> "str | None":
     """stdout of a git command, or None when git/The repo is unavailable
     (the best-effort contract)."""
@@ -523,7 +543,8 @@ def check(repo: str = str(REPO)) -> dict:
                 "invalid_scenarios": [], "invalid_traces": [],
                 "invalid_variances": [], "invalid_timelines": [],
                 "invalid_profile_drifts": [], "invalid_fleetlints": [],
-                "invalid_prefixcaches": [], "invalid_trainfleets": []}
+                "invalid_prefixcaches": [], "invalid_trainfleets": [],
+                "invalid_kernlints": []}
     tracked = set(tracked_raw.split())
     missing = [f for f in REQUIRED
                if not (Path(repo) / f).exists() or f not in tracked]
@@ -560,6 +581,7 @@ def check(repo: str = str(REPO)) -> dict:
     invalid_fl = _validate_fleetlints(repo)
     invalid_pc = _validate_prefixcaches(repo)
     invalid_tf = _validate_trainfleets(repo)
+    invalid_kl = _validate_kernlints(repo)
     return {"ok": not (missing or untracked or dirty or invalid
                        or invalid_mem or invalid_prec or invalid_dec
                        or invalid_obs or invalid_prof or invalid_conv
@@ -567,7 +589,7 @@ def check(repo: str = str(REPO)) -> dict:
                        or invalid_scen or invalid_trace
                        or invalid_var or invalid_tl
                        or invalid_pd or invalid_fl or invalid_pc
-                       or invalid_tf),
+                       or invalid_tf or invalid_kl),
             "missing": missing, "untracked": untracked, "dirty": dirty,
             "invalid_incidents": invalid,
             "invalid_memlints": invalid_mem,
@@ -585,7 +607,8 @@ def check(repo: str = str(REPO)) -> dict:
             "invalid_profile_drifts": invalid_pd,
             "invalid_fleetlints": invalid_fl,
             "invalid_prefixcaches": invalid_pc,
-            "invalid_trainfleets": invalid_tf}
+            "invalid_trainfleets": invalid_tf,
+            "invalid_kernlints": invalid_kl}
 
 
 def main(argv=None) -> int:
@@ -624,7 +647,9 @@ def main(argv=None) -> int:
               f"prefix-cache records "
               f"{verdict.get('invalid_prefixcaches', [])}; invalid "
               f"train-fleet records "
-              f"{verdict.get('invalid_trainfleets', [])}",
+              f"{verdict.get('invalid_trainfleets', [])}; invalid "
+              f"kernlint records "
+              f"{verdict.get('invalid_kernlints', [])}",
               file=sys.stderr)
         return 1
     return 0

@@ -324,7 +324,7 @@ def _lint_serve_program(lane: str, fn, args, props, passes, compile,
     passes = tuple(
         p for p in (passes or GRAPH_PASSES + MEMLINT_PASSES
                     + ("precision",))
-        if p != "policy")
+        if p not in ("policy", "pallas-kernel"))
     if not passes:
         return analysis.Report()
     lowered = analysis.lower_quiet(fn, *args)
@@ -342,8 +342,8 @@ def lint_serve(lane: str, passes=None, compile: bool = True,
     """Lint one serve decode-step lane (graph + memlint + precision
     passes; no policy — the serving step is a bf16 forward by design,
     like the decode lanes)."""
-    if passes is not None and not tuple(p for p in passes
-                                        if p != "policy"):
+    if passes is not None and not tuple(
+            p for p in passes if p not in ("policy", "pallas-kernel")):
         return analysis.Report()
     slots, bs, nb, mb = SERVE_LANES[lane]
     fn, args, props = build_serve_step(slots, bs, nb, mb)
@@ -356,8 +356,8 @@ def lint_serve_prefill(lane: str, passes=None, compile: bool = True,
     """Lint one serve prefill-chunk lane — the split fleet's other
     compiled program, under the same pass matrix as the decode
     lanes."""
-    if passes is not None and not tuple(p for p in passes
-                                        if p != "policy"):
+    if passes is not None and not tuple(
+            p for p in passes if p not in ("policy", "pallas-kernel")):
         return analysis.Report()
     slots, bs, nb, mb = SERVE_PREFILL_LANES[lane]
     fn, args, props = build_serve_prefill(slots, bs, nb, mb)
@@ -370,8 +370,8 @@ def lint_serve_verify(lane: str, passes=None, compile: bool = True,
     """Lint one speculative-verify lane — the b×(k+1) verifier step
     the spec engine dispatches once per speculation round, under the
     same pass matrix as the decode lanes."""
-    if passes is not None and not tuple(p for p in passes
-                                        if p != "policy"):
+    if passes is not None and not tuple(
+            p for p in passes if p not in ("policy", "pallas-kernel")):
         return analysis.Report()
     slots, bs, nb, mb, k = SERVE_VERIFY_LANES[lane]
     fn, args, props = build_serve_verify(slots, bs, nb, mb, k)
@@ -431,9 +431,30 @@ def lint_family(family: str, passes=ALL_PASSES, compile: bool = True,
     if step_passes:
         step, args, props = build_train_step(family, raw=raw,
                                              opt_level=opt_level)
+        closed_jaxpr = None
+        if "pallas-kernel" in step_passes:
+            # the pallas pass reads jaxpr-level BlockSpec structure,
+            # and the step must TRACE with the pallas kernels routed
+            # in (the CLI pins APEX_TPU_KERNELS=jnp for the text
+            # passes) — a fresh jit wrapper keeps the jnp trace/lower
+            # cache unpolluted
+            prev = os.environ.get("APEX_TPU_KERNELS")
+            os.environ["APEX_TPU_KERNELS"] = "pallas"
+            try:
+                pstep, pargs, _ = build_train_step(
+                    family, raw=raw, opt_level=opt_level)
+                closed_jaxpr = pstep.trace(*pargs).jaxpr
+            except Exception:  # noqa: BLE001 - degrades to "skipped"
+                closed_jaxpr = None
+            finally:
+                if prev is None:
+                    os.environ.pop("APEX_TPU_KERNELS", None)
+                else:
+                    os.environ["APEX_TPU_KERNELS"] = prev
         lowered = analysis.lower_quiet(step, *args)
         ctx = analysis.build_context(lowered, compile=compile,
-                                     policy=props)
+                                     policy=props,
+                                     closed_jaxpr=closed_jaxpr)
         options = {"collectives":
                    {"budget": COLLECTIVE_BUDGETS.get(family, {})}}
         options.update(_memlint_options(memory_budget))
@@ -460,7 +481,7 @@ def lint_decode(lane: str, passes=None, compile: bool = True,
     passes = tuple(
         p for p in (passes or GRAPH_PASSES + MEMLINT_PASSES
                     + ("precision",))
-        if p != "policy")
+        if p not in ("policy", "pallas-kernel"))
     if not passes:
         # e.g. --passes policy: nothing applies to a decode lane —
         # skip before paying the build + XLA compilation
@@ -897,7 +918,11 @@ def main(argv=None) -> int:
     ap.add_argument("--families", default=",".join(FAMILIES),
                     help=f"comma list from {FAMILIES}")
     ap.add_argument("--passes", default=",".join(ALL_PASSES),
-                    help=f"comma list from {ALL_PASSES}")
+                    help=f"comma list from {ALL_PASSES}; 'pallas' (= "
+                         f"pallas-kernel) additionally runs the Pallas "
+                         f"kernel sanitizer over the train lanes "
+                         f"(opt-in: it re-traces the step with the "
+                         f"pallas kernels routed in)")
     ap.add_argument("--lanes", default=None,
                     help="comma list from o0,o1,o2,o3,o4,decode,serve,"
                          "fleet (train opt levels incl. the fp8 O4 "
@@ -935,7 +960,9 @@ def main(argv=None) -> int:
     opts = ap.parse_args(argv)
 
     families = [f.strip() for f in opts.families.split(",") if f.strip()]
-    passes = tuple(p.strip() for p in opts.passes.split(",") if p.strip())
+    passes = tuple("pallas-kernel" if p.strip() == "pallas"
+                   else p.strip()
+                   for p in opts.passes.split(",") if p.strip())
     lanes_explicit = opts.lanes is not None
     if opts.lanes is None:
         # the precision pass's documented contract is the full O0–O3
@@ -970,7 +997,7 @@ def main(argv=None) -> int:
     # memory pass requested must be refused, not silently unasserted
     lowering_only = set(passes) <= {"precision", "policy",
                                     "constant-capture", "export-compat",
-                                    "spmd-consistency"}
+                                    "spmd-consistency", "pallas-kernel"}
     if lowering_only and budget is not None:
         ap.error("--memory-budget needs the memory pass; the requested "
                  f"--passes {','.join(passes)} never reads it (an "

@@ -126,6 +126,23 @@ def _time_scan(build, iters: int, trials: int = 3) -> float:
     return max(t_long - t_short, 1e-9) / (5 * iters)
 
 
+def _lint_candidate(build) -> list:
+    """Rule ids the Pallas sanitizer rejects a candidate geometry for.
+
+    Traces one tiny ``scan(2)`` step through
+    :mod:`apex_tpu.analysis.pallas_lint` — trace only, no compile, no
+    execution — and returns the sorted error-severity rule ids (empty
+    = clean).  ``--autotune`` refuses to time or record a knob entry
+    the sanitizer rejects: an over-budget or racy geometry must never
+    win a sweep on a lucky interpret-mode timing and land in the knob
+    table (the export-gate treatment, applied to autotune)."""
+    from apex_tpu.analysis import pallas_lint
+    run, args = build(2)
+    report = pallas_lint.lint_fn(run, *args)
+    return sorted({f.op for f in report.findings
+                   if f.severity == "error" and f.op})
+
+
 def bench_fused_adam(n: int, block_rows: "int | None" = None):
     from apex_tpu.ops.pallas.adam_kernel import adam_geometry, packed_adam
 
@@ -407,6 +424,14 @@ def run_suite(tiny: bool = False, autotune: bool = False) -> dict:
                     # or its floor-gate coverage
                     try:
                         build, _, _ = fn(*args, **{knob: cand})
+                        rejected = _lint_candidate(build)
+                        if rejected:
+                            # sanitizer-rejected geometry: recorded as
+                            # a dict entry, so it is excluded from the
+                            # timed table and can never be chosen
+                            sweep[str(cand)] = \
+                                {"lint_rejected": rejected}
+                            continue
                         # short sweep timings (fewer steps, 2 trials):
                         # the knob's effect is way above the quotient's
                         # noise
