@@ -61,6 +61,7 @@ from apex_tpu.analysis import precision    # noqa: F401  (registers)
 from apex_tpu.analysis import export       # noqa: F401  (registers)
 from apex_tpu.analysis import spmd         # noqa: F401  (registers)
 from apex_tpu.analysis import pallas_lint  # noqa: F401  (registers)
+from apex_tpu.analysis import determinism  # noqa: F401  (registers)
 
 from apex_tpu.analysis.collectives import collective_audit, collective_table
 from apex_tpu.analysis.spmd import (
@@ -81,5 +82,5 @@ __all__ = [
     "reshape_pair_findings", "schedule_fingerprint",
     "donation", "sharding", "collectives", "constants", "policy",
     "memory", "cost", "syncs", "dflow", "precision", "export", "spmd",
-    "pallas_lint",
+    "pallas_lint", "determinism",
 ]

@@ -39,6 +39,12 @@ pass                 catches
                      pinned custom calls, statically-bound scalars,
                      baked weight constants
                      (:mod:`apex_tpu.analysis.export`)
+``determinism``      bitwise-exactness hazards in the gated programs:
+                     float argmax/top-k tie-breaks not in the
+                     greedy_argmax form, unpinned values shared by a
+                     sampling epilogue and a program output, scatters
+                     with non-provably-disjoint windows, PRNG key
+                     reuse (:mod:`apex_tpu.analysis.determinism`)
 ===================  ====================================================
 
 :func:`analyze` lowers (and by default compiles) a jittable function on

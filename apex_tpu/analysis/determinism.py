@@ -1,0 +1,579 @@
+"""Bitwise-determinism lint over the lowered StableHLO.
+
+Every headline gate in this repo — serve-vs-solo, spec accept, disagg
+chaos, prefix-CoW, fleet regrow — is a BITWISE equality on emitted
+tokens, and the two determinism bug classes that have actually bitten
+were both found by hand: the XLA:CPU remat ulp-tie that PR 11
+root-caused into :func:`~apex_tpu.models.generate.pin_logits` /
+:func:`~apex_tpu.models.generate.greedy_argmax`, and the
+"shape-lucky" accumulation class whose ``_attn_cached`` b1-vs-b8
+suspect survived as the documented kv8 tolerance.  This pass
+machine-checks the exactness contract itself, the way the precision
+pass machine-checks the paper's mixed-precision contract.
+
+Four per-lane rules over the :mod:`~apex_tpu.analysis.dflow` SSA walk:
+
+- ``det-tie-argmax`` — a floating argmax / top-k / compare-select
+  epilogue that is NOT the reassociation-proof ``greedy_argmax`` form.
+  jax's native ``jnp.argmax`` outlines to a private function built on a
+  *variadic* ``stablehlo.reduce`` whose reducer region tie-breaks with
+  a ``FLOAT`` compare + select — the exact shape whose winner can move
+  when XLA reassociates the upstream accumulation by one ulp.
+  ``greedy_argmax`` lowers to separate max-reduce / EQ-compare /
+  min-index-reduce ops (no variadic reduce) and never fires.  A
+  tie-break whose float operand derives from a random-bits expansion
+  (the gumbel-perturbed categorical draw) is the *legal* key-seeded
+  form and is recorded as info evidence instead.
+- ``det-multi-materialize`` — one float value consumed by BOTH a
+  sampling/compare epilogue and a program output, with no
+  ``optimization_barrier`` pinning the producer: XLA may materialize
+  the two uses from different rematerializations that differ by an
+  ulp, so the emitted token and the returned logits disagree.  This is
+  the ``pin_logits`` remat class, detected structurally so it fires on
+  any future head, not just gpt.
+- ``det-scatter-order`` — a scatter whose update windows are not
+  statically provably disjoint: ``unique_indices = true`` proves it,
+  and the paged-pool writes' clip+trash routing (indices selected
+  against a constant trash block: ``where(mask, idx, TRASH_BLOCK)``)
+  is recognized as the legal disjointness convention; anything else is
+  an ordering hazard.
+- ``det-prng-reuse`` — one ``ui32`` key token reaching two independent
+  random-bits expansions (calls into threefry-derived private
+  functions): the draws are correlated, and under remat the two
+  expansions may not even agree with each other.
+
+Second half, the cross-lane comparator (the spmd-pass treatment
+applied to *shapes* instead of ranks): :func:`reduction_signatures`
+extracts the canonical reduction signature of every float contraction
+/ reduce — the contracted dim sizes, the operand/accumulation dtypes
+(``preferred_element_type`` shows up as the result dtype) — and
+:func:`compare_signatures` diffs two lanes' signature streams.  A
+multiset difference means the two programs accumulate in genuinely
+different shapes/dtypes somewhere — ``det-lane-shape-variant``, the
+rule that mechanically confirms or clears the ``_attn_cached``
+b1-vs-b8 suspect.  Integer reductions are excluded by construction:
+integer addition is associative, so its order cannot move a bit.
+
+``tools/det_lint.py`` sweeps the full lane matrix into the committed
+``DETLINT_r*.json`` artifact (schema:
+:mod:`apex_tpu.analysis.detlint`); ``tools/graph_lint.py --passes
+determinism`` runs the per-lane rules standalone (lowering-only).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from apex_tpu.analysis import dflow
+from apex_tpu.analysis.core import PassContext, register_pass
+from apex_tpu.analysis.report import Finding
+
+_PASS = "determinism"
+
+#: the rule ids, mirrored stdlib-only in :mod:`apex_tpu.analysis.detlint`
+#: (``tests/l0/test_determinism.py`` pins the two lists equal); the
+#: first four are per-lane, the last is the cross-lane comparator's.
+RULES = ("det-tie-argmax", "det-multi-materialize", "det-scatter-order",
+         "det-prng-reuse", "det-lane-shape-variant")
+
+#: per-lane rules (what a single lowering can fire)
+LANE_RULES = RULES[:4]
+
+_CALLEE = re.compile(r"@([\w$.-]+)")
+#: the threefry2x32 magic constants — any private function whose body
+#: materializes them (or a rotation table) is a random-bits expansion
+_THREEFRY_MARKS = ("466688986", "dense<[13, 15, 26, 6]>")
+_CONTRACT = re.compile(
+    r"contracting_dims\s*=\s*\[([0-9, ]*)\]\s*x\s*\[([0-9, ]*)\]")
+_APPLIES = re.compile(r"applies\s+stablehlo\.(\w+)")
+
+
+def _is_float(elem: Optional[str]) -> bool:
+    return bool(elem) and (elem.startswith("f") or elem.startswith("bf"))
+
+
+def _callee(op: dflow.Op) -> Optional[str]:
+    m = _CALLEE.search(op.line)
+    return m.group(1) if m else None
+
+
+def _producers(fn: dflow.FuncDef) -> Dict[str, dflow.Op]:
+    d: Dict[str, dflow.Op] = {}
+    for op in fn.ops:
+        for r in (op.results or ((op.result,) if op.result else ())):
+            d[r] = op
+    return d
+
+
+def _region_ops(fn: dflow.FuncDef, owner: dflow.Op) -> List[dflow.Op]:
+    return [o for o in fn.ops if any(w is owner for w in o.owners)]
+
+
+def _call_graph(funcs: Dict[str, dflow.FuncDef]) -> Dict[str, set]:
+    return {name: {c for op in fn.ops if op.name == "call"
+                   for c in [_callee(op)] if c}
+            for name, fn in funcs.items()}
+
+
+def _rng_funcs(funcs: Dict[str, dflow.FuncDef]) -> set:
+    """Functions that (transitively) expand random bits: a threefry
+    constant or ``rng_bit_generator`` in the body, or a call into one."""
+    calls = _call_graph(funcs)
+    rng = set()
+    for name, fn in funcs.items():
+        for op in fn.ops:
+            if op.name == "rng_bit_generator" or (
+                    op.name == "constant"
+                    and any(m in op.line for m in _THREEFRY_MARKS)):
+                rng.add(name)
+                break
+    changed = True
+    while changed:
+        changed = False
+        for name, cs in calls.items():
+            if name not in rng and cs & rng:
+                rng.add(name)
+                changed = True
+    return rng
+
+
+def _tie_sites(fn: dflow.FuncDef) -> List[Tuple[dflow.Op, str]]:
+    """Tie-breaking epilogue ops in one function body: the variadic
+    float argmax reduce, float top-k, and unstable float sorts."""
+    sites = []
+    for op in fn.ops:
+        if op.name == "reduce" and op.n_results >= 2 and any(
+                _is_float(dflow.element_type(t)) for t in op.types):
+            region = _region_ops(fn, op)
+            if any(o.name == "compare" and "FLOAT" in o.line
+                   for o in region) and \
+                    any(o.name == "select" for o in region):
+                sites.append((op, "variadic argmax reduce"))
+        elif op.name == "top_k" and any(
+                _is_float(dflow.element_type(t)) for t in op.types):
+            sites.append((op, "top_k"))
+        elif op.name == "sort" and "is_stable = false" in op.line:
+            region = _region_ops(fn, op)
+            if any(o.name == "compare" and "FLOAT" in o.line
+                   for o in region):
+                sites.append((op, "unstable float sort"))
+    return sites
+
+
+def _derives_from_rng(fn: dflow.FuncDef, producers: Dict[str, dflow.Op],
+                      token: str, rng: set, depth: int = 6) -> bool:
+    """True when ``token``'s value derives from a random-bits expansion
+    within ``depth`` producer steps — the legal key-perturbed tie-break
+    (gumbel trick) adds the noise right next to the argmax."""
+    frontier = {fn.resolve(token)}
+    seen = set()
+    for _ in range(depth):
+        nxt = set()
+        for tok in frontier:
+            if tok in seen:
+                continue
+            seen.add(tok)
+            op = producers.get(tok)
+            if op is None:
+                continue
+            if op.name == "call" and _callee(op) in rng:
+                return True
+            for o in op.operands:
+                nxt.add(fn.resolve(o))
+        frontier = nxt
+    return False
+
+
+class _IndexWalk:
+    """Interprocedural backward walk over scatter-index chains.
+
+    jax outlines the clip+trash routing freely — ``jnp.where(mask,
+    idx, TRASH_BLOCK)`` can sit in a private ``@_where`` the caller
+    only sees as a ``call``, and the scatter itself often sits in an
+    outlined update function whose flat indices arrive as function
+    arguments.  The guard test must follow both directions: down into
+    a callee's returned chain (with the call-site binding so a
+    constant passed as an argument is still a constant), and up from
+    a function argument to every call site's actual operand.
+    """
+
+    def __init__(self, funcs: Dict[str, dflow.FuncDef]):
+        self.funcs = funcs
+        self.producers = {n: _producers(fn) for n, fn in funcs.items()}
+        self.arg_pos = {n: {tok: i for i, (tok, _p) in enumerate(fn.args)}
+                        for n, fn in funcs.items()}
+        self.call_sites: Dict[str, List[Tuple[str, dflow.Op]]] = {}
+        for name, fn in funcs.items():
+            for op in fn.ops:
+                if op.name == "call":
+                    c = _callee(op)
+                    if c:
+                        self.call_sites.setdefault(c, []).append(
+                            (name, op))
+
+    def _const(self, fname: str, token: str, env, steps: int = 4) -> bool:
+        """``token`` is (transitively) a constant, through broadcasts /
+        reshapes / converts and caller bindings recorded in ``env``."""
+        fn = self.funcs[fname]
+        tok = fn.resolve(token)
+        for _ in range(steps):
+            op = self.producers[fname].get(tok)
+            if op is None:
+                pos = self.arg_pos[fname].get(tok)
+                if pos is not None and env is not None:
+                    caller, call_op, cenv = env
+                    if pos < len(call_op.operands):
+                        return self._const(caller,
+                                           call_op.operands[pos], cenv,
+                                           steps)
+                return False
+            if op.name == "constant":
+                return True
+            if op.name in ("broadcast_in_dim", "reshape",
+                           "convert") and op.operands:
+                tok = fn.resolve(op.operands[0])
+                continue
+            return False
+        return False
+
+    def guarded(self, fname: str, token: str, env=None,
+                depth: int = 10, level: int = 2) -> bool:
+        """A ``select`` whose taken-or-not branch is a constant — the
+        ``where(mask, idx, TRASH_BLOCK)`` clip+trash routing — is
+        reachable backward from ``token``."""
+        fn = self.funcs[fname]
+        frontier = {fn.resolve(token)}
+        seen = set()
+        args_hit: List[int] = []
+        for _ in range(depth):
+            nxt = set()
+            for tok in frontier:
+                if tok in seen:
+                    continue
+                seen.add(tok)
+                op = self.producers[fname].get(tok)
+                if op is None:
+                    pos = self.arg_pos[fname].get(tok)
+                    if pos is not None:
+                        if env is not None:
+                            caller, call_op, cenv = env
+                            if pos < len(call_op.operands) \
+                                    and level > 0 and self.guarded(
+                                        caller, call_op.operands[pos],
+                                        env=cenv, depth=depth,
+                                        level=level - 1):
+                                return True
+                        else:
+                            args_hit.append(pos)
+                    continue
+                if op.name == "select" and any(
+                        self._const(fname, b, env)
+                        for b in op.operands[1:]):
+                    return True
+                if op.name == "call" and level > 0:
+                    callee = _callee(op)
+                    if callee in self.funcs:
+                        for ret in self.funcs[callee].returns:
+                            if any(self.guarded(callee, rt,
+                                                env=(fname, op, env),
+                                                depth=depth,
+                                                level=level - 1)
+                                   for rt in ret.operands):
+                                return True
+                    continue
+                for o in op.operands:
+                    nxt.add(fn.resolve(o))
+            frontier = nxt
+        if args_hit and level > 0 and env is None:
+            # the chain left through this function's arguments: the
+            # guard must hold at EVERY call site (each call executes
+            # the scatter with its own indices)
+            sites = self.call_sites.get(fname, [])
+            return bool(sites) and all(
+                any(pos < len(call_op.operands)
+                    and self.guarded(caller, call_op.operands[pos],
+                                     depth=depth, level=level - 1)
+                    for pos in args_hit)
+                for caller, call_op in sites)
+        return False
+
+
+def _token_elem(fn: dflow.FuncDef, producers: Dict[str, dflow.Op],
+                token: str) -> Optional[str]:
+    tok = fn.resolve(token)
+    op = producers.get(tok)
+    if op is not None:
+        return op.result_elem
+    for arg_tok, payload in fn.args:
+        if arg_tok == tok:
+            return dflow.element_type(payload)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the registered pass
+# ---------------------------------------------------------------------------
+
+def determinism_findings(text: str) -> List[Finding]:
+    """All per-lane determinism findings for one lowered module."""
+    funcs = dflow.parse_module(text)
+    mn = dflow.main_func(funcs)
+    if mn is None:
+        return [Finding(_PASS, "error", "no function found in the "
+                        "lowered module", op="det-parse")]
+    rng = _rng_funcs(funcs)
+    calls = _call_graph(funcs)
+    called = set().union(*calls.values()) if calls else set()
+    walk = _IndexWalk(funcs)
+
+    findings: List[Finding] = []
+    n_epilogue = n_scatter = n_rng_calls = n_barriers = 0
+
+    # tie-prone private functions: outlined argmax/top-k bodies — the
+    # finding attributes at the CALL SITE (where the escape analysis
+    # can see the operand's provenance), not inside the outlined body
+    tie_funcs: Dict[str, str] = {}
+    for name, fn in funcs.items():
+        sites = _tie_sites(fn)
+        if sites and name in called:
+            tie_funcs[name] = sites[0][1]
+
+    for name, fn in funcs.items():
+        producers = _producers(fn)
+
+        # --- det-tie-argmax -------------------------------------------
+        sites: List[Tuple[dflow.Op, str, Optional[str]]] = []
+        for op in fn.ops:
+            if op.name == "call" and _callee(op) in tie_funcs:
+                floats = [t for t, ty in zip(op.operands, op.types)
+                          if _is_float(dflow.element_type(ty))]
+                sites.append((op, tie_funcs[_callee(op)],
+                              floats[0] if floats else (
+                                  op.operands[0] if op.operands
+                                  else None)))
+        if name not in tie_funcs:
+            # inline tie sites in a function nobody calls (main): no
+            # call site will carry them, flag the op itself
+            sites += [(o, k, o.operands[0] if o.operands else None)
+                      for o, k in _tie_sites(fn)]
+        for site, skind, stok in sites:
+                n_epilogue += 1
+                if stok is not None and _derives_from_rng(
+                        fn, producers, stok, rng):
+                    findings.append(Finding(
+                        _PASS, "info",
+                        f"key-perturbed tie-break ({skind}): operand "
+                        f"derives from a random-bits expansion — the "
+                        f"legal seeded draw",
+                        op="det-tie-argmax", lineno=site.lineno))
+                else:
+                    findings.append(Finding(
+                        _PASS, "error",
+                        f"ulp-tie hazard: {skind} over float values "
+                        f"not in the reassociation-proof greedy_argmax "
+                        f"form — a one-ulp remat/reassociation can "
+                        f"move the winner",
+                        op="det-tie-argmax", lineno=site.lineno,
+                        example=site.line.strip()[:160]))
+
+        # --- det-scatter-order ----------------------------------------
+        for op in fn.ops:
+            if op.name != "scatter":
+                continue
+            n_scatter += 1
+            if "unique_indices = true" in op.line:
+                findings.append(Finding(
+                    _PASS, "info", "scatter with unique_indices=true: "
+                    "update disjointness proven", op="det-scatter-order",
+                    lineno=op.lineno))
+            elif len(op.operands) >= 2 and walk.guarded(
+                    name, op.operands[1]):
+                findings.append(Finding(
+                    _PASS, "info", "non-unique scatter with clip+trash "
+                    "index routing: masked writes statically land in "
+                    "the sacrificial block", op="det-scatter-order",
+                    lineno=op.lineno))
+            else:
+                findings.append(Finding(
+                    _PASS, "error",
+                    "scatter with statically non-provably-disjoint "
+                    "update windows (unique_indices=false, no "
+                    "clip+trash index guard): colliding writes commit "
+                    "in unspecified order",
+                    op="det-scatter-order", lineno=op.lineno,
+                    example=op.line.strip()[:160]))
+
+        # --- det-prng-reuse -------------------------------------------
+        consumers_by_tok: Dict[str, List[dflow.Op]] = {}
+        for op in fn.ops:
+            if op.name == "call" and _callee(op) in rng:
+                n_rng_calls += 1
+                for t in op.operands:
+                    consumers_by_tok.setdefault(
+                        fn.resolve(t), []).append(op)
+        for tok, ops in consumers_by_tok.items():
+            if len(ops) < 2:
+                continue
+            if _token_elem(fn, producers, tok) != "ui32":
+                continue  # shared f32 minval/maxval scalars are fine
+            findings.append(Finding(
+                _PASS, "error",
+                f"PRNG key reuse: one key token feeds {len(ops)} "
+                f"independent random-bits expansions "
+                f"({', '.join(sorted({_callee(o) or '?' for o in ops}))})"
+                f" — draws are correlated and remat-unstable",
+                op="det-prng-reuse", lineno=ops[0].lineno, count=1,
+                example=ops[0].line.strip()[:160]))
+
+        n_barriers += sum(1 for op in fn.ops
+                          if op.name == "optimization_barrier")
+
+    # --- det-multi-materialize (program outputs: main only) -----------
+    producers = _producers(mn)
+    main_tie_ids = {id(o) for o, _k in _tie_sites(mn)}
+    epilogue_uses: Dict[str, List[Tuple[dflow.Op, str]]] = {}
+    for op in mn.ops:
+        why = None
+        if op.name == "call":
+            c = _callee(op)
+            if c in tie_funcs:
+                why = f"tie-breaking call @{c}"
+            elif c in rng:
+                why = f"random-bits call @{c}"
+        elif id(op) in main_tie_ids:
+            why = "inline tie-break"
+        if why:
+            for t in op.operands:
+                epilogue_uses.setdefault(
+                    mn.resolve(t), []).append((op, why))
+    ret_tokens = []
+    for ret in mn.returns:
+        for t in ret.operands:
+            tok = mn.resolve(t)
+            if tok not in ret_tokens:
+                ret_tokens.append(tok)
+    for tok in ret_tokens:
+        if tok not in epilogue_uses:
+            continue
+        prod = producers.get(tok)
+        if prod is None:
+            continue  # a function argument: an input, not a remat
+        if not _is_float(prod.result_elem):
+            continue
+        use_op, why = epilogue_uses[tok][0]
+        if prod.name == "optimization_barrier":
+            findings.append(Finding(
+                _PASS, "info",
+                f"barrier-pinned shared value: {why} and a program "
+                f"output both read one materialization",
+                op="det-multi-materialize", lineno=prod.lineno))
+        else:
+            findings.append(Finding(
+                _PASS, "error",
+                f"multi-materialization hazard: value {tok} (from "
+                f"{prod.name}) is both a program output and feeds "
+                f"{why}, with no optimization_barrier pinning one "
+                f"materialization — remat can hand the two uses "
+                f"ulp-different copies (the pin_logits class)",
+                op="det-multi-materialize", lineno=use_op.lineno,
+                example=prod.line.strip()[:160]))
+
+    # evidence counters: the DETLINT 'checked' block re-derives from
+    # these, so a lane that linted nothing cannot read as clean-by-vacuum
+    findings.append(Finding(_PASS, "info", "argmax/top-k/sort epilogue "
+                            "sites examined", op="det-epilogue-sites",
+                            count=n_epilogue))
+    findings.append(Finding(_PASS, "info", "scatter sites examined",
+                            op="det-scatter-sites", count=n_scatter))
+    findings.append(Finding(_PASS, "info", "random-bits expansion call "
+                            "sites", op="det-rng-calls",
+                            count=n_rng_calls))
+    findings.append(Finding(_PASS, "info", "optimization_barrier pins",
+                            op="det-barriers", count=n_barriers))
+    return findings
+
+
+def determinism_pass(ctx: PassContext, **options) -> List[Finding]:
+    return determinism_findings(ctx.stablehlo_text)
+
+
+register_pass("determinism", determinism_pass)
+
+
+# ---------------------------------------------------------------------------
+# the cross-lane reduction-shape comparator (det-lane-shape-variant)
+# ---------------------------------------------------------------------------
+
+def reduction_signatures(text: str) -> List[Tuple[str, Tuple[int, ...],
+                                                  Tuple[str, ...]]]:
+    """The module's float reduction signature stream, in text order.
+
+    One entry per float contraction/reduce: ``(kind, contracted dim
+    sizes, element types)`` where kind is ``"dot"`` or
+    ``"reduce:<applied op>"`` (``"reduce:region"`` for generic
+    region-bodied reduces) and the element types run operands-then-
+    result, so ``preferred_element_type`` accumulation shows up as the
+    trailing dtype.  Batch/free dims are deliberately EXCLUDED — b1 vs
+    b8 must compare equal when the per-element accumulation order is
+    identical; only the contracted extent can move a bit.  Integer-only
+    entries are dropped: integer addition is associative, its order
+    cannot change the result.
+    """
+    sigs: List[Tuple[str, Tuple[int, ...], Tuple[str, ...]]] = []
+    for fn in dflow.parse_module(text).values():
+        for op in fn.ops:
+            if op.name == "dot_general":
+                m = _CONTRACT.search(op.line)
+                if not m or len(op.types) < 2:
+                    continue
+                lhs = dflow.dims_of(op.types[0])
+                contracted = tuple(
+                    lhs[int(d)] for d in m.group(1).split(",")
+                    if d.strip().isdigit() and int(d) < len(lhs))
+                elems = tuple(dflow.element_type(t) for t in op.types)
+                if any(_is_float(e) for e in elems):
+                    sigs.append(("dot", contracted, elems))
+            elif op.name == "reduce":
+                am = _APPLIES.search(op.line)
+                kind = f"reduce:{am.group(1)}" if am else "reduce:region"
+                elems = tuple(dflow.element_type(t) for t in op.types)
+                if any(_is_float(e) for e in elems):
+                    sigs.append((kind, op.reduce_dims(), elems))
+    return sigs
+
+
+def signature_json(sigs: Sequence[Tuple[str, Tuple[int, ...],
+                                        Tuple[str, ...]]]) -> list:
+    """JSON-ready form: ``[[kind, [dims...], [elems...]], ...]``."""
+    return [[k, list(d), list(e)] for k, d, e in sigs]
+
+
+def compare_signatures(name_a: str, sigs_a, name_b: str,
+                       sigs_b) -> dict:
+    """Diff two lanes' signature streams — the
+    ``det-lane-shape-variant`` verdict.
+
+    ``"cleared"`` when the multisets match (the two programs perform
+    the same float accumulations in the same shapes and dtypes;
+    ``positional`` additionally records whether they match in program
+    order).  Otherwise ``"variant"`` with one record per signature
+    present in only one lane.
+    """
+    a = [tuple((k, tuple(d), tuple(e))) for k, d, e in sigs_a]
+    b = [tuple((k, tuple(d), tuple(e))) for k, d, e in sigs_b]
+    counts: Dict[tuple, int] = {}
+    for s in a:
+        counts[s] = counts.get(s, 0) + 1
+    for s in b:
+        counts[s] = counts.get(s, 0) - 1
+    variants = []
+    for sig in sorted(k for k, v in counts.items() if v != 0):
+        n = counts[sig]
+        variants.append({
+            "only_in": name_a if n > 0 else name_b,
+            "kind": sig[0], "dims": list(sig[1]), "elems": list(sig[2]),
+            "count": abs(n)})
+    return {"verdict": "cleared" if not variants else "variant",
+            "positional": a == b, "variants": variants,
+            "counts": {name_a: len(a), name_b: len(b)}}

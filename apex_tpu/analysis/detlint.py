@@ -1,0 +1,337 @@
+"""DETLINT_r*.json — schema for the committed determinism-lint sweep.
+
+``tools/det_lint.py --out DETLINT_rN.json`` writes one of these per
+round: every gated program lane (the solo/batched/kv8 decode steps,
+the serve decode/prefill/verify steps) lowered and run through the
+four per-lane :mod:`apex_tpu.analysis.determinism` rules, plus the
+cross-lane reduction-shape comparator pairs with their recorded
+signature streams and verdicts.  Like MEMLINT/PRECLINT/FLEETLINT/
+KERNLINT, the artifact is gate memory: ``tools/gate_hygiene.py``
+validates every committed ``DETLINT_r*.json`` against this schema so
+"every gated program is bitwise-deterministic, and b1/b8 accumulate
+identically" can't rot into prose nobody machine-checks.
+
+This module is deliberately **stdlib-only** (no jax import):
+``gate_hygiene`` loads it directly by file path the same way it loads
+``analysis/kernlint.py``.
+
+Document shape::
+
+    {
+      "round": 1,
+      "platform": "cpu",
+      "rules": ["det-tie-argmax", ...],      # the full rule list
+      "lanes": {
+        "<lane>": {                # e.g. "decode_b1", "serve_step"
+          "ok": true,              # MUST re-derive from the counts below
+          "findings": {            # per-rule ERROR counts
+            "det-tie-argmax": 0, ...      # keys: the per-lane rules
+          },
+          "checked": {             # evidence the pass looked at anything
+            "epilogue_sites": 1, "scatter_sites": 3,
+            "rng_calls": 3, "barriers": 1
+          },
+          "waivers": {             # optional: rule -> documented reason;
+            "<rule>": "why"        #   a waived rule needs findings > 0
+          },
+          "error": "..."           # optional: lane failed to lower;
+        }, ...                     #   forces ok=false
+      },
+      "pairs": {                   # the det-lane-shape-variant verdicts
+        "decode_b1|decode_b8": {
+          "lanes": ["decode_b1", "decode_b8"],
+          "signatures": {          # full ordered signature streams
+            "decode_b1": [["dot", [16], ["bf16","bf16","f32"]], ...],
+            "decode_b8": [...]
+          },
+          "verdict": "cleared",    # MUST re-derive from the signatures
+          "positional": true,      # streams identical in program order
+          "variants": [],          # MUST equal the multiset diff
+          "expected": false,       # variant only: documented tolerance?
+          "reason": "..."          # required when expected=true
+        }, ...
+      },
+      "gate": {"ok": true, "lanes_clean": 7, "lanes_total": 7,
+               "pairs_ok": 3, "pairs_total": 3}       # re-derived
+    }
+
+The contradiction rules: a lane's ``ok`` must equal "zero unwaived
+finding counts and no error"; a ``checked`` block that counted nothing
+anywhere needs an ``error`` explaining it (a lane that linted nothing
+is not clean, it is unexamined); a pair's ``verdict``/``variants``/
+``positional`` must re-derive from the recorded signature streams — a
+"cleared" verdict sitting on divergent signatures is invalid, as is a
+recorded variant list that disagrees with the recomputed multiset
+diff; a "variant" verdict needs an explicit ``expected`` bool, and
+``expected: true`` needs a non-empty ``reason`` (the documented
+tolerance class, e.g. the kv8 dequant path); ``gate.*`` must re-derive
+from the lane and pair verdicts, where a pair is ok when cleared or an
+expected (reasoned) variant.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Tuple
+
+#: the determinism rule ids (mirrored here so the validator stays
+#: stdlib-only; ``tests/l0/test_determinism.py`` pins the two lists
+#: equal so they cannot drift).  The first four are per-lane; the last
+#: is the cross-lane comparator's and never appears in lane findings.
+RULES = ("det-tie-argmax", "det-multi-materialize", "det-scatter-order",
+         "det-prng-reuse", "det-lane-shape-variant")
+
+#: the rules a single lane's findings block may record
+LANE_RULES = RULES[:4]
+
+#: the comparator's rule id (pair-scoped, not lane-scoped)
+PAIR_RULE = RULES[4]
+
+
+def _canon_sig(entry) -> Tuple:
+    return (entry[0], tuple(entry[1]), tuple(entry[2]))
+
+
+def _sig_ok(entry) -> bool:
+    return (isinstance(entry, list) and len(entry) == 3
+            and isinstance(entry[0], str)
+            and isinstance(entry[1], list)
+            and all(isinstance(d, int) for d in entry[1])
+            and isinstance(entry[2], list)
+            and all(isinstance(e, str) for e in entry[2]))
+
+
+def _diff_signatures(a: list, b: list, name_a: str,
+                     name_b: str) -> List[dict]:
+    """The multiset difference, in the wire shape ``variants`` uses —
+    the same arithmetic :func:`apex_tpu.analysis.determinism.
+    compare_signatures` performs, reimplemented here so the validator
+    needs no jax."""
+    counts: Dict[Tuple, int] = {}
+    for e in a:
+        counts[_canon_sig(e)] = counts.get(_canon_sig(e), 0) + 1
+    for e in b:
+        counts[_canon_sig(e)] = counts.get(_canon_sig(e), 0) - 1
+    out = []
+    for sig in sorted(k for k, v in counts.items() if v != 0):
+        n = counts[sig]
+        out.append({"only_in": name_a if n > 0 else name_b,
+                    "kind": sig[0], "dims": list(sig[1]),
+                    "elems": list(sig[2]), "count": abs(n)})
+    return out
+
+
+def _validate_lane(name: str, rec: dict, rules: tuple,
+                   problems: List[str]) -> None:
+    if not isinstance(rec.get("ok"), bool):
+        problems.append(f"lane {name!r} missing/invalid 'ok' (bool)")
+        return
+    findings = rec.get("findings")
+    if not isinstance(findings, dict):
+        problems.append(f"lane {name!r} missing 'findings' object")
+        return
+    for rule, count in findings.items():
+        if rule not in rules or rule == PAIR_RULE:
+            problems.append(f"lane {name!r} records rule {rule!r} "
+                            f"(lane findings take the per-lane rules, "
+                            f"not {PAIR_RULE!r} or unknowns)")
+        if not (isinstance(count, int) and count >= 0):
+            problems.append(f"lane {name!r} finding count for {rule!r} "
+                            f"is not an int >= 0: {count!r}")
+            return
+    checked = rec.get("checked")
+    if not (isinstance(checked, dict) and checked and all(
+            isinstance(k, str) and isinstance(v, int) and v >= 0
+            for k, v in checked.items())):
+        problems.append(f"lane {name!r} missing/invalid 'checked' "
+                        f"(object of site-class -> int >= 0)")
+        return
+    error = rec.get("error")
+    if error is not None and not (isinstance(error, str)
+                                  and error.strip()):
+        problems.append(f"lane {name!r} has invalid 'error' "
+                        f"(non-empty str)")
+    waivers = rec.get("waivers", {})
+    if not isinstance(waivers, dict):
+        problems.append(f"lane {name!r} has invalid 'waivers' "
+                        f"(object of rule -> reason)")
+        return
+    for rule, reason in waivers.items():
+        if rule not in rules:
+            problems.append(f"lane {name!r} waives unknown rule "
+                            f"{rule!r}")
+        if not (isinstance(reason, str) and reason.strip()):
+            problems.append(f"lane {name!r} waiver for {rule!r} needs "
+                            f"a non-empty reason")
+        if findings.get(rule, 0) == 0:
+            problems.append(f"lane {name!r} waives {rule!r} which "
+                            f"recorded no findings (stale waiver)")
+
+    # the contradiction rules: the verdict must re-derive from the
+    # recorded evidence, and a lane that examined nothing is not clean
+    unwaived = sum(c for rule, c in findings.items()
+                   if isinstance(c, int) and rule not in waivers)
+    derived = unwaived == 0 and error is None
+    if rec["ok"] != derived:
+        if error is not None:
+            why = f"a recorded lane error ({error[:60]!r})"
+        elif unwaived:
+            why = f"{unwaived} unwaived finding(s)"
+        else:
+            why = "zero unwaived findings and no error"
+        problems.append(f"lane {name!r}: ok={rec['ok']} contradicts "
+                        f"{why}")
+    if error is None and not any(checked.values()):
+        problems.append(f"lane {name!r}: every 'checked' counter is "
+                        f"zero and no 'error' explains it — a lane "
+                        f"that examined nothing must not read as clean")
+
+
+def _validate_pair(key: str, rec: dict, problems: List[str]) -> None:
+    lanes = rec.get("lanes")
+    if not (isinstance(lanes, list) and len(lanes) == 2
+            and all(isinstance(x, str) for x in lanes)):
+        problems.append(f"pair {key!r} missing/invalid 'lanes' "
+                        f"(two lane names)")
+        return
+    if key != "|".join(lanes):
+        problems.append(f"pair {key!r} key disagrees with its lanes "
+                        f"{lanes}")
+    sigs = rec.get("signatures")
+    if not (isinstance(sigs, dict)
+            and all(x in sigs for x in lanes)):
+        problems.append(f"pair {key!r} missing 'signatures' for both "
+                        f"lanes (the verdict must carry its evidence)")
+        return
+    for lane in lanes:
+        if not (isinstance(sigs[lane], list)
+                and all(_sig_ok(e) for e in sigs[lane])):
+            problems.append(f"pair {key!r} signatures for {lane!r} are "
+                            f"not [kind, [dims], [elems]] entries")
+            return
+    verdict = rec.get("verdict")
+    if verdict not in ("cleared", "variant"):
+        problems.append(f"pair {key!r} verdict {verdict!r} not in "
+                        f"('cleared', 'variant')")
+        return
+    a, b = lanes
+    derived = _diff_signatures(sigs[a], sigs[b], a, b)
+    recorded = rec.get("variants")
+    if not isinstance(recorded, list):
+        problems.append(f"pair {key!r} missing 'variants' list")
+        return
+    def _vkey(v):
+        return (v.get("only_in"), v.get("kind"), tuple(v.get("dims", [])),
+                tuple(v.get("elems", [])), v.get("count"))
+    if sorted(map(_vkey, recorded)) != sorted(map(_vkey, derived)):
+        problems.append(f"pair {key!r}: recorded variants disagree "
+                        f"with the multiset diff of the recorded "
+                        f"signatures ({len(recorded)} recorded vs "
+                        f"{len(derived)} derived)")
+    want = "cleared" if not derived else "variant"
+    if verdict != want:
+        problems.append(f"pair {key!r}: verdict {verdict!r} "
+                        f"contradicts the recorded signatures "
+                        f"(diff says {want!r})")
+    positional = rec.get("positional")
+    if not isinstance(positional, bool):
+        problems.append(f"pair {key!r} missing/invalid 'positional' "
+                        f"(bool)")
+    else:
+        pos_want = [_canon_sig(e) for e in sigs[a]] == \
+            [_canon_sig(e) for e in sigs[b]]
+        if positional != pos_want:
+            problems.append(f"pair {key!r}: positional={positional} "
+                            f"contradicts the recorded signature "
+                            f"streams")
+    if verdict == "variant":
+        expected = rec.get("expected")
+        if not isinstance(expected, bool):
+            problems.append(f"pair {key!r}: a variant verdict needs an "
+                            f"explicit 'expected' bool")
+        elif expected and not (isinstance(rec.get("reason"), str)
+                               and rec["reason"].strip()):
+            problems.append(f"pair {key!r}: expected=true needs a "
+                            f"non-empty 'reason' (the documented "
+                            f"tolerance class)")
+
+
+def pair_ok(rec: dict) -> bool:
+    """A pair passes the gate when cleared, or a documented (expected,
+    reasoned) variant."""
+    if rec.get("verdict") == "cleared":
+        return True
+    return rec.get("verdict") == "variant" \
+        and rec.get("expected") is True \
+        and isinstance(rec.get("reason"), str) and bool(
+            rec["reason"].strip())
+
+
+def validate_detlint(doc) -> List[str]:
+    """Problems with one parsed DETLINT document (empty = valid)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not a JSON object"]
+    if not isinstance(doc.get("round"), int):
+        problems.append("missing/invalid 'round' (int)")
+    if not isinstance(doc.get("platform"), str):
+        problems.append("missing/invalid 'platform' (str)")
+    rules = doc.get("rules")
+    if not (isinstance(rules, list) and rules
+            and all(isinstance(r, str) for r in rules)):
+        problems.append("missing/invalid 'rules' (non-empty list of "
+                        "rule-id strings)")
+        rules = list(RULES)
+    lanes = doc.get("lanes")
+    if not isinstance(lanes, dict) or not lanes:
+        return problems + ["missing/empty 'lanes' object"]
+    for name, rec in lanes.items():
+        if not isinstance(rec, dict):
+            problems.append(f"lane {name!r} is not an object")
+            continue
+        _validate_lane(name, rec, tuple(rules), problems)
+
+    pairs = doc.get("pairs")
+    if not isinstance(pairs, dict) or not pairs:
+        problems.append("missing/empty 'pairs' object (the comparator "
+                        "verdicts are half the artifact's point)")
+        pairs = {}
+    for key, rec in pairs.items():
+        if not isinstance(rec, dict):
+            problems.append(f"pair {key!r} is not an object")
+            continue
+        _validate_pair(key, rec, problems)
+
+    gate = doc.get("gate")
+    if not isinstance(gate, dict):
+        problems.append("missing 'gate' object")
+        return problems
+    clean = sum(1 for rec in lanes.values()
+                if isinstance(rec, dict) and rec.get("ok") is True)
+    p_ok = sum(1 for rec in pairs.values()
+               if isinstance(rec, dict) and pair_ok(rec))
+    want = {"lanes_clean": clean, "lanes_total": len(lanes),
+            "pairs_ok": p_ok, "pairs_total": len(pairs)}
+    for key, val in want.items():
+        if not isinstance(gate.get(key), int):
+            problems.append(f"gate missing/invalid {key!r} (int)")
+        elif gate[key] != val:
+            problems.append(f"gate.{key}={gate[key]} contradicts the "
+                            f"records (counted {val})")
+    if not isinstance(gate.get("ok"), bool):
+        problems.append("gate missing/invalid 'ok' (bool)")
+    elif gate["ok"] != (clean == len(lanes) and p_ok == len(pairs)):
+        problems.append(f"gate.ok={gate['ok']} contradicts the lane/"
+                        f"pair verdicts ({clean}/{len(lanes)} lanes "
+                        f"clean, {p_ok}/{len(pairs)} pairs ok)")
+    return problems
+
+
+def validate_detlint_file(path: str) -> List[str]:
+    """Problems with one DETLINT_r*.json file (empty = valid)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable detlint JSON: {e}"]
+    return validate_detlint(doc)

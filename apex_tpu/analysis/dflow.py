@@ -45,7 +45,7 @@ _FUNC = re.compile(
 _ARG = re.compile(r"(%\w+):\s*tensor<([^<>]*)>")
 _RESULT_INFO = re.compile(r'jax\.result_info\s*=\s*"([^"]*)"')
 _OP = re.compile(
-    r"^\s*(?:(%\w+)(?::(\d+))?\s*=\s*)?"
+    r"^\s*(?:(%\w+(?:\s*,\s*%\w+)*)(?::(\d+))?\s*=\s*)?"
     r"\"?((?:stablehlo|chlo|mhlo|func)\.[\w]+|call|return)\b\"?")
 _VALUE = re.compile(r"%[\w]+(?:#\d+)?")
 _BIND = re.compile(r"(%\w+)\s*=\s*(%[\w]+(?:#\d+)?)")
@@ -91,6 +91,10 @@ class Op:
     #: enclosing region-owner ops, outermost first (``while``/``case``/
     #: ``if``/``reduce``/... bodies this op's line sits inside)
     owners: Tuple["Op", ...] = ()
+    #: every result token: ``("%33",)`` for the common case, the named
+    #: list for ``%values, %indices = chlo.top_k(...)``-style prints
+    #: (consumers reference the names directly, not ``%33#k``)
+    results: Tuple[str, ...] = ()
 
     @property
     def result_type(self) -> Optional[str]:
@@ -189,8 +193,11 @@ def parse_module(text: str) -> Dict[str, FuncDef]:
         om = _OP.search(line)
         op = None
         if om:
-            result = om.group(1)
-            n_results = int(om.group(2)) if om.group(2) else 1
+            result_toks = tuple(_VALUE.findall(om.group(1))) \
+                if om.group(1) else ()
+            result = result_toks[0] if result_toks else None
+            n_results = int(om.group(2)) if om.group(2) \
+                else max(len(result_toks), 1)
             name = om.group(3).split(".")[-1]
             tail = line
             if result is not None:
@@ -208,7 +215,8 @@ def parse_module(text: str) -> Dict[str, FuncDef]:
             op = Op(lineno=lineno, line=line, name=name, result=result,
                     n_results=n_results, operands=operands,
                     types=tuple(_TENSOR.findall(line)), depth=depth - 1,
-                    owners=tuple(o for o, _d in region_stack))
+                    owners=tuple(o for o, _d in region_stack),
+                    results=result_toks)
             if name == "return":
                 if depth == 1 and "stablehlo" not in om.group(3):
                     cur.returns.append(op)

@@ -335,6 +335,52 @@ def _ingest_kernlint(doc, prev) -> List[Row]:
     return rows
 
 
+@adapter("DETLINT")
+def _ingest_detlint(doc, prev) -> List[Row]:
+    """Bitwise-determinism lint rounds: per-lane clean verdict (1.0 =
+    zero unwaived tie/materialize/scatter/PRNG findings over the
+    lowered program) and total error-finding count, per-pair
+    comparator verdict (1.0 = reduction-signature streams cleared,
+    0.0 = an undocumented lane-shape variant) with its variant-class
+    count, plus the gate's clean-lane and cleared-pair fractions —
+    the longitudinal record that every gated program stays in the
+    reassociation-proof forms and that paired lanes keep identical
+    float-reduction shapes."""
+    rows: List[Row] = []
+    for lane, rec in sorted((doc.get("lanes") or {}).items()):
+        if not isinstance(rec, dict):
+            continue
+        if isinstance(rec.get("ok"), bool):
+            rows.append((f"lane:{lane}", "lint_clean", float(rec["ok"])))
+        findings = rec.get("findings")
+        if isinstance(findings, dict):
+            total = sum(v for v in findings.values() if _num(v))
+            rows.append((f"lane:{lane}", "rule_findings", float(total)))
+    for key, rec in sorted((doc.get("pairs") or {}).items()):
+        if not isinstance(rec, dict):
+            continue
+        if rec.get("verdict") in ("cleared", "variant"):
+            rows.append((f"pair:{key}", "cleared",
+                         float(rec["verdict"] == "cleared")))
+        variants = rec.get("variants")
+        if isinstance(variants, list):
+            rows.append((f"pair:{key}", "variant_classes",
+                         float(len(variants))))
+    gate = doc.get("gate")
+    if isinstance(gate, dict):
+        if _num(gate.get("lanes_total")) and gate["lanes_total"] > 0 \
+                and _num(gate.get("lanes_clean")):
+            rows.append(("gate", "lanes_clean_frac",
+                         round(gate["lanes_clean"]
+                               / gate["lanes_total"], 4)))
+        if _num(gate.get("pairs_total")) and gate["pairs_total"] > 0 \
+                and _num(gate.get("pairs_ok")):
+            rows.append(("gate", "pairs_ok_frac",
+                         round(gate["pairs_ok"]
+                               / gate["pairs_total"], 4)))
+    return rows
+
+
 @adapter("PREFIXCACHE")
 def _ingest_prefixcache(doc, prev) -> List[Row]:
     """Prefix-sharing rounds: per-arm deterministic counts (prefill

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.models.generate import greedy_argmax
 from apex_tpu.utils.jax_compat import axis_size as _axis_size
 
 
@@ -37,7 +38,11 @@ def top1_routing(logits: jax.Array, capacity: int
     """
     T, E = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    expert = jnp.argmax(probs, axis=-1)                      # (T,)
+    # reassociation-proof routing: jnp.argmax's tie-break can differ
+    # between the dispatch and combine consumers under refusion, and a
+    # router tie that flips experts between the two poisons the
+    # capacity bookkeeping (det-tie-argmax)
+    expert = greedy_argmax(probs)                            # (T,)
     onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)    # (T, E)
     # position of each token within its expert's queue (zero on the E-1
     # non-selected columns so the row-sum is exactly the queue index)

@@ -168,7 +168,7 @@ PATTERNS = ("BENCH_LADDER_BASELINES.json", "SCALING_SWEEP.json",
             "TRACE_r*.json", "TIMELINE_r*.json",
             "PROFILE_DRIFT_r*.json", "FLEETLINT_r*.json",
             "PREFIXCACHE_r*.json", "TRAINFLEET_r*.json",
-            "KERNLINT_r*.json")
+            "KERNLINT_r*.json", "DETLINT_r*.json")
 
 #: Round-numbered incident artifacts additionally get schema-validated.
 INCIDENT_PATTERN = "INCIDENT_r*.json"
@@ -222,8 +222,11 @@ PREFIXCACHE_PATTERN = "PREFIXCACHE_r*.json"
 #: ... and the elastic-training-fleet chaos-drill artifacts ...
 TRAINFLEET_PATTERN = "TRAINFLEET_r*.json"
 
-#: ... and the Pallas kernel-sanitizer sweep artifacts.
+#: ... and the Pallas kernel-sanitizer sweep artifacts ...
 KERNLINT_PATTERN = "KERNLINT_r*.json"
+
+#: ... and the bitwise-determinism lint artifacts.
+DETLINT_PATTERN = "DETLINT_r*.json"
 
 
 def _load_by_path(repo: str, *rel: str):
@@ -512,6 +515,23 @@ def _validate_kernlints(repo: str) -> "list[str]":
     return problems
 
 
+def _validate_detlints(repo: str) -> "list[str]":
+    """Schema problems over every present DETLINT_r*.json, as
+    ``path: problem`` strings (``apex_tpu/analysis/detlint.py`` —
+    which also re-derives every per-lane ``ok`` verdict from the
+    recorded finding counts and waivers, every comparator verdict
+    from the recorded signature streams, and ``gate.ok`` from
+    both)."""
+    schema = _load_by_path(repo, "apex_tpu", "analysis", "detlint.py")
+    if schema is None:
+        return []
+    problems = []
+    for p in sorted(Path(repo).glob(DETLINT_PATTERN)):
+        for msg in schema.validate_detlint_file(str(p)):
+            problems.append(f"{p.name}: {msg}")
+    return problems
+
+
 def _git(repo: str, *args: str) -> "str | None":
     """stdout of a git command, or None when git/The repo is unavailable
     (the best-effort contract)."""
@@ -544,7 +564,7 @@ def check(repo: str = str(REPO)) -> dict:
                 "invalid_variances": [], "invalid_timelines": [],
                 "invalid_profile_drifts": [], "invalid_fleetlints": [],
                 "invalid_prefixcaches": [], "invalid_trainfleets": [],
-                "invalid_kernlints": []}
+                "invalid_kernlints": [], "invalid_detlints": []}
     tracked = set(tracked_raw.split())
     missing = [f for f in REQUIRED
                if not (Path(repo) / f).exists() or f not in tracked]
@@ -582,6 +602,7 @@ def check(repo: str = str(REPO)) -> dict:
     invalid_pc = _validate_prefixcaches(repo)
     invalid_tf = _validate_trainfleets(repo)
     invalid_kl = _validate_kernlints(repo)
+    invalid_dl = _validate_detlints(repo)
     return {"ok": not (missing or untracked or dirty or invalid
                        or invalid_mem or invalid_prec or invalid_dec
                        or invalid_obs or invalid_prof or invalid_conv
@@ -589,7 +610,7 @@ def check(repo: str = str(REPO)) -> dict:
                        or invalid_scen or invalid_trace
                        or invalid_var or invalid_tl
                        or invalid_pd or invalid_fl or invalid_pc
-                       or invalid_tf or invalid_kl),
+                       or invalid_tf or invalid_kl or invalid_dl),
             "missing": missing, "untracked": untracked, "dirty": dirty,
             "invalid_incidents": invalid,
             "invalid_memlints": invalid_mem,
@@ -608,7 +629,8 @@ def check(repo: str = str(REPO)) -> dict:
             "invalid_fleetlints": invalid_fl,
             "invalid_prefixcaches": invalid_pc,
             "invalid_trainfleets": invalid_tf,
-            "invalid_kernlints": invalid_kl}
+            "invalid_kernlints": invalid_kl,
+            "invalid_detlints": invalid_dl}
 
 
 def main(argv=None) -> int:
@@ -649,7 +671,9 @@ def main(argv=None) -> int:
               f"train-fleet records "
               f"{verdict.get('invalid_trainfleets', [])}; invalid "
               f"kernlint records "
-              f"{verdict.get('invalid_kernlints', [])}",
+              f"{verdict.get('invalid_kernlints', [])}; invalid "
+              f"detlint records "
+              f"{verdict.get('invalid_detlints', [])}",
               file=sys.stderr)
         return 1
     return 0

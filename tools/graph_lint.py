@@ -944,7 +944,7 @@ def main(argv=None) -> int:
                          "= v5e 16 GiB; 512MiB / 2GiB forms accepted)")
     ap.add_argument("--emit-json", default=None,
                     metavar="MEMLINT_rN.json|PRECLINT_rN.json|"
-                            "FLEETLINT_rN.json",
+                            "FLEETLINT_rN.json|DETLINT_rN.json",
                     help="write a committed lint artifact, dispatched "
                          "on the file name: MEMLINT_r*.json = all "
                          "passes over O1+O2 train + decode + serve + "
@@ -954,7 +954,10 @@ def main(argv=None) -> int:
                          "(lowering only); FLEETLINT_r*.json = the "
                          "cross-rank SPMD consistency lanes (per-rank "
                          "DDP O1/O2 schedules + the reshape pair, "
-                         "lowering only)")
+                         "lowering only); DETLINT_r*.json = the "
+                         "determinism pass + cross-lane reduction "
+                         "comparator over every gated decode/serve "
+                         "lane (lowering only, via tools/det_lint.py)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print every finding, not just errors")
     opts = ap.parse_args(argv)
@@ -968,8 +971,15 @@ def main(argv=None) -> int:
         # the precision pass's documented contract is the full O0–O3
         # matrix; every other pass combination keeps the historical
         # o1,decode default (+ the serve-engine step)
-        opts.lanes = "o0,o1,o2,o3,o4,decode,serve" \
-            if passes == ("precision",) else "o1,decode,serve"
+        if passes == ("precision",):
+            opts.lanes = "o0,o1,o2,o3,o4,decode,serve"
+        elif passes == ("determinism",):
+            # the bitwise-gated programs: every decode + serve lane
+            # (train steps emit no tokens; nothing there is gated
+            # on bitwise equality)
+            opts.lanes = "decode,serve"
+        else:
+            opts.lanes = "o1,decode,serve"
     lanes = [x.strip().lower() for x in opts.lanes.split(",") if x.strip()]
     unknown = [f for f in families if f not in FAMILIES]
     if unknown:
@@ -997,7 +1007,8 @@ def main(argv=None) -> int:
     # memory pass requested must be refused, not silently unasserted
     lowering_only = set(passes) <= {"precision", "policy",
                                     "constant-capture", "export-compat",
-                                    "spmd-consistency", "pallas-kernel"}
+                                    "spmd-consistency", "pallas-kernel",
+                                    "determinism"}
     if lowering_only and budget is not None:
         ap.error("--memory-budget needs the memory pass; the requested "
                  f"--passes {','.join(passes)} never reads it (an "
@@ -1007,6 +1018,41 @@ def main(argv=None) -> int:
         # (not under --emit-json: the artifact branches own their
         # compile story and their --passes diagnostics)
         opts.no_compile = True
+
+    if opts.emit_json and \
+            os.path.basename(opts.emit_json).startswith("DETLINT"):
+        # the determinism artifact's contract is the full gated-lane
+        # matrix + every comparator pair under the determinism pass
+        # alone — a restricted run must be refused, never silently
+        # committed as a full document (the armed-gate-asserts-nothing
+        # class)
+        if passes not in (ALL_PASSES, ("determinism",)):
+            ap.error("--emit-json DETLINT_r*.json runs exactly the "
+                     "determinism pass over the gated-program lanes; "
+                     "drop --passes (or pass --passes determinism)")
+        if tuple(families) != FAMILIES:
+            ap.error("--families does not apply to the determinism "
+                     "lanes (they lower the decode/serve programs, "
+                     "not a model family); drop --families")
+        if lanes_explicit:
+            ap.error("--emit-json DETLINT_r*.json always writes every "
+                     "gated lane (decode b1/b8/kv8 + serve step/"
+                     "decode/prefill/verify) and every comparator "
+                     "pair; drop --lanes")
+        if budget is not None:
+            ap.error("--memory-budget does not apply to the "
+                     "determinism artifact (lowering-only; no "
+                     "compiled memory analysis) — an armed budget "
+                     "that asserts nothing must not pass the gate")
+        import det_lint                       # sibling tool: the sweep
+        rc = det_lint.main(["--out", opts.emit_json]
+                           + (["-v"] if opts.verbose else []))
+        if rc:
+            print("graph lint FAILED: determinism sweep recorded "
+                  "unwaived findings, an undocumented lane-shape "
+                  "variant, or schema problems — see the artifact",
+                  file=sys.stderr)
+        return rc
 
     if opts.emit_json and \
             os.path.basename(opts.emit_json).startswith("FLEETLINT"):

@@ -71,6 +71,7 @@ Usage: python tools/kernel_bench.py [--out KERNELBENCH.json]
 
 import argparse
 import json
+import re
 import sys
 import time
 from pathlib import Path
@@ -625,6 +626,20 @@ def main(argv=None):
                          "published roofline-fraction floor (on-chip "
                          "gate; off-TPU the floors block is skipped)")
     args = ap.parse_args(argv)
+
+    # A determinism-lint round name on a kernel-bench document is the
+    # armed-gate-asserts-nothing failure: gate_hygiene would validate
+    # the file against the DETLINT schema (and reject it), but until
+    # then a DETLINT_rN.json full of microbenchmark timings asserts
+    # nothing about tie-breaks or reduction shapes.  Refuse the name;
+    # the sweep lives in tools/det_lint.py.
+    if re.match(r"DETLINT_r\d+\.json$", Path(args.out).name):
+        ap.error(f"--out {args.out}: DETLINT_rN.json is the "
+                 "bitwise-determinism lint artifact family (emitted by "
+                 "tools/det_lint.py or graph_lint --emit-json); a "
+                 "kernel-bench document under that name would be "
+                 "schema-rejected by gate_hygiene and, until then, "
+                 "assert nothing the name promises")
 
     result = run_suite(tiny=args.tiny, autotune=args.autotune)
     # The floors block is ALWAYS recorded; roofline fractions are only
